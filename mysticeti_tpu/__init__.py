@@ -1,0 +1,25 @@
+"""mysticeti-tpu: a TPU-native DAG-consensus framework.
+
+A brand-new implementation (not a port) with the capabilities of the Mysticeti
+consensus prototype (reference: hrubaanna/mysticeti): statement-block DAG, threshold
+clock rounds, wave-based direct/indirect commit rule with multi-leader + pipelining,
+fast-path transaction certification, WAL-backed crash recovery, full-mesh validator
+networking, deterministic whole-system simulation, prometheus observability, and a
+benchmark harness — with the block-verification hot path (batched Blake2b digests +
+Ed25519) executed on TPU via JAX (vmap/jit/shard_map, Pallas kernels).
+
+Package layout:
+  types / crypto / serde / committee / range_map / threshold_clock  — L1-L2 foundation
+  wal / block_store / state                                         — L3 persistence
+  block_manager / core / epoch_close                                — L4 engine
+  consensus/                                                        — L5 commit rule
+  block_handler / commit_observer / block_validator                 — L6 app interface
+  syncer / network / net_sync / synchronizer                        — L8 networking
+  runtime/ + simulator                                              — L9 determinism
+  metrics                                                           — L10 observability
+  ops/                      — JAX/TPU kernels (Ed25519, SHA-512, field arithmetic)
+  parallel/                 — mesh/sharding for multi-chip batch verification
+  models/                   — assembled verification pipelines (the TPU "models")
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,465 @@
+"""Committee: stake table, thresholds, leader election, vote aggregation.
+
+Capability parity with ``mysticeti-core/src/committee.rs``:
+
+* ``Committee`` with validity (>1/3) and quorum (>2/3) stake thresholds
+  (committee.rs:25-30,56-81) and genesis block construction (committee.rs:98-114).
+* Deterministic stake-weighted leader election (committee.rs:149-180) — our own
+  blake2b-PRF weighted sampling without replacement; CONSENSUS-CRITICAL: every
+  validator must compute the identical leader, so the scheme below is part of the
+  protocol definition, not an implementation detail.
+* ``StakeAggregator`` over quorum/validity thresholds (committee.rs:256-330).
+* ``TransactionAggregator`` — the per-transaction fast-path vote/certification
+  engine over locator ranges (committee.rs:363-482), backed by ``RangeMap``.
+* ``VoteRangeBuilder`` — run-length compression of accept votes (committee.rs:498-524).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import crypto
+from .range_map import RangeMap
+from .serde import Reader, Writer
+from .types import (
+    AuthorityIndex,
+    AuthoritySet,
+    BlockReference,
+    Epoch,
+    MAX_COMMITTEE_SIZE,
+    Share,
+    StatementBlock,
+    TransactionLocator,
+    TransactionLocatorRange,
+    Vote,
+    VoteRange,
+)
+
+Stake = int
+
+QUORUM = "quorum"
+VALIDITY = "validity"
+
+ROUND_ROBIN = "round_robin"
+STAKE_WEIGHTED = "stake_weighted"
+
+
+class Authority:
+    """One committee member: stake + verifying key + hostname (committee.rs:197-218)."""
+
+    __slots__ = ("stake", "public_key", "hostname")
+
+    def __init__(self, stake: Stake, public_key: crypto.PublicKey, hostname: str = "") -> None:
+        self.stake = stake
+        self.public_key = public_key
+        self.hostname = hostname
+
+
+class Committee:
+    """The validator set for one epoch (committee.rs:24-30).
+
+    ``leader_election`` selects round-robin (the reference's cfg(test) strategy,
+    committee.rs:140-146 — used by the committer gold suite) or the production
+    stake-weighted scheme.
+    """
+
+    def __init__(
+        self,
+        authorities: Sequence[Authority],
+        epoch: Epoch = 0,
+        leader_election: str = STAKE_WEIGHTED,
+    ) -> None:
+        if not authorities:
+            raise ValueError("committee must not be empty")
+        if len(authorities) > MAX_COMMITTEE_SIZE:
+            raise ValueError(f"committee larger than {MAX_COMMITTEE_SIZE}")
+        if any(a.stake <= 0 for a in authorities):
+            raise ValueError("all stakes must be positive")
+        self.authorities: Tuple[Authority, ...] = tuple(authorities)
+        self.epoch = epoch
+        self.leader_election = leader_election
+        self.total_stake: Stake = sum(a.stake for a in authorities)
+        # is_valid: amount > total/3 ; is_quorum: amount > 2*total/3 (committee.rs:56-57,120-127)
+        self._validity_floor = self.total_stake // 3
+        self._quorum_floor = 2 * self.total_stake // 3
+
+    # -- constructors --
+
+    @classmethod
+    def new_test(cls, stakes: Sequence[Stake], epoch: Epoch = 0) -> "Committee":
+        """Test committee with dummy keys + round-robin election (committee.rs:36-39)."""
+        dummy = crypto.Signer.dummy().public_key
+        return cls(
+            [Authority(s, dummy) for s in stakes], epoch, leader_election=ROUND_ROBIN
+        )
+
+    @classmethod
+    def new_for_benchmarks(cls, size: int, epoch: Epoch = 0) -> "Committee":
+        """Equal-stake committee with deterministic per-index keys (committee.rs:190-193)."""
+        signers = [crypto.Signer.from_seed(i.to_bytes(32, "little")) for i in range(size)]
+        return cls(
+            [Authority(1, s.public_key) for s in signers], epoch,
+            leader_election=STAKE_WEIGHTED,
+        )
+
+    @staticmethod
+    def benchmark_signers(size: int) -> List[crypto.Signer]:
+        return [crypto.Signer.from_seed(i.to_bytes(32, "little")) for i in range(size)]
+
+    # -- thresholds --
+
+    def validity_threshold(self) -> Stake:
+        return self._validity_floor + 1
+
+    def quorum_threshold(self) -> Stake:
+        return self._quorum_floor + 1
+
+    def is_valid(self, amount: Stake) -> bool:
+        return amount > self._validity_floor
+
+    def is_quorum(self, amount: Stake) -> bool:
+        return amount > self._quorum_floor
+
+    def threshold_predicate(self, kind: str) -> Callable[[Stake], bool]:
+        if kind == QUORUM:
+            return self.is_quorum
+        if kind == VALIDITY:
+            return self.is_valid
+        raise ValueError(f"unknown threshold kind {kind}")
+
+    # -- lookups --
+
+    def __len__(self) -> int:
+        return len(self.authorities)
+
+    def known_authority(self, authority: AuthorityIndex) -> bool:
+        return 0 <= authority < len(self.authorities)
+
+    def get_stake(self, authority: AuthorityIndex) -> Stake:
+        return self.authorities[authority].stake
+
+    def get_public_key(self, authority: AuthorityIndex) -> crypto.PublicKey:
+        return self.authorities[authority].public_key
+
+    def authority_indexes(self) -> range:
+        return range(len(self.authorities))
+
+    def get_total_stake(self, authorities: Iterable[AuthorityIndex]) -> Stake:
+        return sum(self.authorities[a].stake for a in authorities)
+
+    # -- genesis (committee.rs:98-114) --
+
+    def genesis_blocks(self, for_authority: AuthorityIndex):
+        own = StatementBlock.new_genesis(for_authority, self.epoch)
+        others = [
+            StatementBlock.new_genesis(a, self.epoch)
+            for a in self.authority_indexes()
+            if a != for_authority
+        ]
+        return own, others
+
+    # -- leader election --
+
+    def elect_leader(self, round_: int, offset: int = 0) -> AuthorityIndex:
+        """Leader for (round, offset) (committee.rs:137-146)."""
+        if self.leader_election == ROUND_ROBIN:
+            return (round_ + offset) % len(self.authorities)
+        return self.elect_leader_stake_based(round_, offset)
+
+    def elect_leader_stake_based(self, round_: int, offset: int) -> AuthorityIndex:
+        """Deterministic stake-weighted election without replacement
+        (semantics of committee.rs:149-180; our own PRF, documented protocol rule):
+
+        draws 0..=offset each pick one authority with probability proportional to
+        stake among those not yet drawn, using ``blake2b(b"leader" || round || draw)``
+        as the randomness.  Distinct offsets in the same round therefore always yield
+        distinct leaders.
+        """
+        if offset >= len(self.authorities):
+            raise ValueError("offset must be < committee size")
+        if round_ == 0:
+            return 0
+        remaining: List[Tuple[AuthorityIndex, Stake]] = [
+            (i, a.stake) for i, a in enumerate(self.authorities)
+        ]
+        total = self.total_stake
+        chosen = 0
+        for draw in range(offset + 1):
+            seed = hashlib.blake2b(
+                b"mysticeti-tpu/leader"
+                + round_.to_bytes(8, "little")
+                + draw.to_bytes(8, "little"),
+                digest_size=16,
+            ).digest()
+            point = int.from_bytes(seed, "little") % total
+            acc = 0
+            for j, (idx, stake) in enumerate(remaining):
+                acc += stake
+                if point < acc:
+                    chosen = idx
+                    total -= stake
+                    remaining.pop(j)
+                    break
+        return chosen
+
+
+class StakeAggregator:
+    """Accumulates distinct authority votes until a stake threshold
+    (committee.rs:256-330).  ``kind`` is "quorum" or "validity"."""
+
+    __slots__ = ("kind", "votes", "stake")
+
+    def __init__(self, kind: str = QUORUM) -> None:
+        self.kind = kind
+        self.votes = AuthoritySet()
+        self.stake: Stake = 0
+
+    def add(self, vote: AuthorityIndex, committee: Committee) -> bool:
+        if self.votes.insert(vote):
+            self.stake += committee.get_stake(vote)
+        return committee.threshold_predicate(self.kind)(self.stake)
+
+    def is_reached(self, committee: Committee) -> bool:
+        return committee.threshold_predicate(self.kind)(self.stake)
+
+    def clear(self) -> None:
+        self.votes.clear()
+        self.stake = 0
+
+    def copy(self) -> "StakeAggregator":
+        """Independent copy — required by RangeMap fragment splitting."""
+        dup = StakeAggregator(self.kind)
+        dup.votes = self.votes.copy()
+        dup.stake = self.stake
+        return dup
+
+    def voters(self):
+        return self.votes.present()
+
+    # state snapshot encoding (for WAL persistence of aggregator state)
+    def encode(self, w: Writer) -> None:
+        w.u8(0 if self.kind == QUORUM else 1)
+        w.u64(self.stake)
+        w.bytes(self.votes.bits.to_bytes(64, "little"))
+
+    @staticmethod
+    def decode(r: Reader) -> "StakeAggregator":
+        kind = QUORUM if r.u8() == 0 else VALIDITY
+        agg = StakeAggregator(kind)
+        agg.stake = r.u64()
+        agg.votes = AuthoritySet(int.from_bytes(r.bytes(), "little"))
+        return agg
+
+
+class TransactionAggregator:
+    """Fast-path vote/certification engine over transaction locator ranges
+    (committee.rs:363-482).
+
+    ``pending`` maps a sharing block's reference to a RangeMap of offset ranges →
+    StakeAggregator.  When a range reaches the threshold it is removed and reported
+    processed.  ``handler`` hooks mirror ProcessedTransactionHandler
+    (committee.rs:297-312): by default a set of processed locators that panics on
+    votes for unknown transactions and on duplicate shares (the reference's
+    HashSet impl, committee.rs:314-330).
+    """
+
+    def __init__(self, kind: str = QUORUM, track_processed: bool = True) -> None:
+        self.kind = kind
+        self.pending: Dict[BlockReference, RangeMap] = {}
+        self.track_processed = track_processed
+        self.processed: Set[TransactionLocator] = set()
+
+    # handler hooks — overridable by subclasses
+    def transaction_processed(self, k: TransactionLocator) -> None:
+        if self.track_processed:
+            self.processed.add(k)
+
+    def duplicate_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
+        if self.track_processed and k not in self.processed:
+            raise RuntimeError(f"duplicate transaction {k} from {from_}")
+
+    def unknown_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
+        if self.track_processed and k not in self.processed:
+            raise RuntimeError(f"vote for unknown transaction {k} from {from_}")
+
+    def is_processed(self, k: TransactionLocator) -> bool:
+        return k in self.processed
+
+    # -- core operations (committee.rs:364-425) --
+
+    def register(
+        self,
+        locator_range: TransactionLocatorRange,
+        vote: AuthorityIndex,
+        committee: Committee,
+    ) -> None:
+        """A block shared these transactions; start aggregation with the author's
+        implicit self-vote."""
+        range_map = self.pending.setdefault(locator_range.block, RangeMap())
+
+        def mutate(sub_start: int, sub_end: int, agg):
+            if agg is not None:
+                for off in range(sub_start, sub_end):
+                    self.duplicate_transaction(
+                        TransactionLocator(locator_range.block, off), vote
+                    )
+                return agg
+            new_agg = StakeAggregator(self.kind)
+            new_agg.add(vote, committee)
+            return new_agg
+
+        range_map.mutate_range(
+            locator_range.offset_start_inclusive,
+            locator_range.offset_end_exclusive,
+            mutate,
+        )
+
+    def vote(
+        self,
+        locator_range: TransactionLocatorRange,
+        vote: AuthorityIndex,
+        committee: Committee,
+        processed_out: List[TransactionLocator],
+    ) -> None:
+        range_map = self.pending.get(locator_range.block)
+        if range_map is None:
+            for loc in locator_range.locators():
+                self.unknown_transaction(loc, vote)
+            return
+
+        def mutate(sub_start: int, sub_end: int, agg):
+            if agg is None:
+                for off in range(sub_start, sub_end):
+                    self.unknown_transaction(
+                        TransactionLocator(locator_range.block, off), vote
+                    )
+                return None
+            if agg.add(vote, committee):
+                for off in range(sub_start, sub_end):
+                    k = TransactionLocator(locator_range.block, off)
+                    self.transaction_processed(k)
+                    processed_out.append(k)
+                return None  # certified: drop from pending
+            return agg
+
+        range_map.mutate_range(
+            locator_range.offset_start_inclusive,
+            locator_range.offset_end_exclusive,
+            mutate,
+        )
+        if range_map.is_empty():
+            del self.pending[locator_range.block]
+
+    def process_block(
+        self,
+        block: StatementBlock,
+        response: Optional[List[object]],
+        committee: Committee,
+    ) -> List[TransactionLocator]:
+        """Tally one block's shares and votes (committee.rs:450-482).
+
+        Shares register new aggregations (and, if ``response`` is given, emit our own
+        VoteRange replies into it); Vote/VoteRange statements are tallied; returns
+        locators newly certified by this block.
+        """
+        processed: List[TransactionLocator] = []
+        for rng in shared_ranges(block):
+            self.register(rng, block.author(), committee)
+            if response is not None:
+                response.append(VoteRange(rng))
+        for st in block.statements:
+            if isinstance(st, Vote):
+                if st.accept:
+                    self.vote(
+                        TransactionLocatorRange(st.locator.block, st.locator.offset,
+                                                st.locator.offset + 1),
+                        block.author(), committee, processed,
+                    )
+                else:
+                    raise NotImplementedError("reject votes not implemented (parity: committee.rs:470)")
+            elif isinstance(st, VoteRange):
+                self.vote(st.range, block.author(), committee, processed)
+        return processed
+
+    def __len__(self) -> int:
+        return len(self.pending)
+
+    def is_empty(self) -> bool:
+        return not self.pending
+
+    # -- state snapshot (committee.rs:352-362), our own encoding --
+
+    def state(self) -> bytes:
+        w = Writer()
+        w.u32(len(self.pending))
+        for block_ref in sorted(self.pending):
+            rm = self.pending[block_ref]
+            block_ref.encode(w)
+            w.u32(len(rm))
+            for s, e, agg in rm.items():
+                w.u64(s).u64(e)
+                agg.encode(w)
+        return w.finish()
+
+    def with_state(self, state: bytes) -> None:
+        if self.pending:
+            raise RuntimeError("with_state requires an empty aggregator")
+        r = Reader(state)
+        for _ in range(r.u32()):
+            block_ref = BlockReference.decode(r)
+            rm = RangeMap()
+            n = r.u32()
+            for _ in range(n):
+                s, e = r.u64(), r.u64()
+                agg = StakeAggregator.decode(r)
+                rm.mutate_range(s, e, lambda a, b, _old, agg=agg: agg)
+            self.pending[block_ref] = rm
+        r.expect_done()
+
+
+def shared_ranges(block: StatementBlock) -> List[TransactionLocatorRange]:
+    """Contiguous runs of Share statements in a block as locator ranges
+    (types.rs shared_ranges equivalent used by committee.rs:455)."""
+    ranges: List[TransactionLocatorRange] = []
+    start: Optional[int] = None
+    for i, st in enumerate(block.statements):
+        if isinstance(st, Share):
+            if start is None:
+                start = i
+        else:
+            if start is not None:
+                ranges.append(TransactionLocatorRange(block.reference, start, i))
+                start = None
+    if start is not None:
+        ranges.append(
+            TransactionLocatorRange(block.reference, start, len(block.statements))
+        )
+    return ranges
+
+
+class VoteRangeBuilder:
+    """Run-length compression of vote offsets (committee.rs:498-524)."""
+
+    __slots__ = ("_start", "_end")
+
+    def __init__(self) -> None:
+        self._start: Optional[int] = None
+        self._end = 0
+
+    def add(self, offset: int) -> Optional[Tuple[int, int]]:
+        """Feed the next offset; returns a completed (start, end) run when the new
+        offset is not contiguous with the current run."""
+        if self._start is None:
+            self._start, self._end = offset, offset + 1
+            return None
+        if self._end == offset:
+            self._end = offset + 1
+            return None
+        result = (self._start, self._end)
+        self._start, self._end = offset, offset + 1
+        return result
+
+    def finish(self) -> Optional[Tuple[int, int]]:
+        if self._start is None:
+            return None
+        return (self._start, self._end)

@@ -1,0 +1,242 @@
+"""Tests for committee thresholds, election, aggregation — mirrors
+committee.rs:530-553 plus wider coverage of the fast-path certification engine."""
+import pytest
+
+from mysticeti_tpu.committee import (
+    Committee,
+    QUORUM,
+    StakeAggregator,
+    TransactionAggregator,
+    VALIDITY,
+    VoteRangeBuilder,
+    shared_ranges,
+)
+from mysticeti_tpu.types import (
+    Share,
+    StatementBlock,
+    TransactionLocator,
+    TransactionLocatorRange,
+    Vote,
+    VoteRange,
+)
+
+
+class TestThresholds:
+    def test_quorum_validity(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        assert c.total_stake == 4
+        assert c.quorum_threshold() == 3  # > 2/3 of 4
+        assert c.validity_threshold() == 2  # > 1/3 of 4
+        assert not c.is_quorum(2)
+        assert c.is_quorum(3)
+        assert not c.is_valid(1)
+        assert c.is_valid(2)
+
+    def test_uneven_stake(self):
+        c = Committee.new_test([100, 200, 300, 400])
+        assert c.total_stake == 1000
+        assert c.is_quorum(667)
+        assert not c.is_quorum(666)
+        assert c.is_valid(334)
+        assert not c.is_valid(333)
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            Committee.new_test([])
+
+    def test_zero_stake_rejected(self):
+        with pytest.raises(ValueError):
+            Committee.new_test([1, 0, 1])
+
+
+class TestLeaderElection:
+    def test_round_robin(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        assert [c.elect_leader(r) for r in range(5)] == [0, 1, 2, 3, 0]
+        assert c.elect_leader(1, offset=2) == 3
+
+    def test_stake_based_distinct_per_offset(self):
+        """committee.rs:530-546 stake_aware_leader_election."""
+        c = Committee.new_test([100, 200, 300, 400, 500])
+        leaders = {c.elect_leader_stake_based(10, off) for off in range(5)}
+        assert len(leaders) == 5  # all distinct
+
+    def test_stake_based_deterministic(self):
+        c = Committee.new_test([100, 200, 300, 400, 500])
+        for r in range(1, 20):
+            assert c.elect_leader_stake_based(r, 0) == c.elect_leader_stake_based(r, 0)
+
+    def test_stake_based_weighting(self):
+        """An authority with overwhelming stake should win most rounds."""
+        c = Committee.new_test([1, 1, 1, 10000])
+        wins = sum(1 for r in range(1, 101) if c.elect_leader_stake_based(r, 0) == 3)
+        assert wins > 90
+
+    def test_genesis_round_leader_zero(self):
+        c = Committee.new_test([5, 1, 1, 1])
+        assert c.elect_leader_stake_based(0, 0) == 0
+
+
+class TestStakeAggregator:
+    def test_quorum(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = StakeAggregator(QUORUM)
+        assert not agg.add(0, c)
+        assert not agg.add(0, c)  # duplicate vote doesn't double-count
+        assert not agg.add(1, c)
+        assert agg.add(2, c)
+
+    def test_validity(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = StakeAggregator(VALIDITY)
+        assert not agg.add(0, c)
+        assert agg.add(1, c)
+
+    def test_encode_decode(self):
+        from mysticeti_tpu.serde import Reader, Writer
+
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = StakeAggregator(QUORUM)
+        agg.add(1, c)
+        agg.add(3, c)
+        w = Writer()
+        agg.encode(w)
+        back = StakeAggregator.decode(Reader(w.finish()))
+        assert back.kind == QUORUM
+        assert back.stake == agg.stake
+        assert sorted(back.voters()) == [1, 3]
+
+
+def _block_with_shares(authority, n_tx, signers=None):
+    genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+    return StatementBlock.build(
+        authority, 1, [g.reference for g in genesis],
+        [Share(bytes([i])) for i in range(n_tx)],
+    )
+
+
+class TestTransactionAggregator:
+    def test_fast_path_certification(self):
+        """Author's share is an implicit vote; 2 more votes certify (4-committee)."""
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        block = _block_with_shares(0, 5)
+        processed = agg.process_block(block, None, c)
+        assert processed == []  # shares only register
+        assert len(agg) == 1
+
+        rng = TransactionLocatorRange(block.reference, 0, 5)
+        out = []
+        agg.vote(rng, 1, c, out)
+        assert out == []
+        agg.vote(rng, 2, c, out)  # third distinct authority → quorum
+        assert len(out) == 5
+        assert agg.is_empty()
+        assert agg.is_processed(TransactionLocator(block.reference, 3))
+
+    def test_author_self_vote_not_double_counted(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        block = _block_with_shares(0, 1)
+        agg.process_block(block, None, c)
+        out = []
+        agg.vote(TransactionLocatorRange(block.reference, 0, 1), 0, c, out)
+        assert out == []  # author voting again adds no stake
+
+    def test_partial_range_votes(self):
+        """Votes over sub-ranges split the aggregation correctly (RangeMap)."""
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        block = _block_with_shares(0, 10)
+        agg.process_block(block, None, c)
+        out = []
+        agg.vote(TransactionLocatorRange(block.reference, 0, 6), 1, c, out)
+        agg.vote(TransactionLocatorRange(block.reference, 3, 10), 2, c, out)
+        # only [3,6) has author + 1 + 2 = quorum
+        assert sorted(k.offset for k in out) == [3, 4, 5]
+        assert not agg.is_empty()
+        out2 = []
+        agg.vote(TransactionLocatorRange(block.reference, 0, 3), 2, c, out2)
+        assert sorted(k.offset for k in out2) == [0, 1, 2]
+
+    def test_vote_for_unknown_transaction_raises(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        ref = StatementBlock.new_genesis(0).reference
+        with pytest.raises(RuntimeError, match="unknown"):
+            agg.vote(TransactionLocatorRange(ref, 0, 1), 1, c, [])
+
+    def test_process_block_emits_vote_ranges(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        block = _block_with_shares(0, 3)
+        response = []
+        agg.process_block(block, response, c)
+        assert len(response) == 1
+        assert isinstance(response[0], VoteRange)
+        assert response[0].range == TransactionLocatorRange(block.reference, 0, 3)
+
+    def test_process_block_tallies_vote_statements(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        share_block = _block_with_shares(0, 2)
+        agg.process_block(share_block, None, c)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        vb1 = StatementBlock.build(
+            1, 1, [g.reference for g in genesis],
+            [VoteRange(TransactionLocatorRange(share_block.reference, 0, 2))],
+        )
+        vb2 = StatementBlock.build(
+            2, 1, [g.reference for g in genesis],
+            [Vote(TransactionLocator(share_block.reference, 0)),
+             Vote(TransactionLocator(share_block.reference, 1))],
+        )
+        assert agg.process_block(vb1, None, c) == []
+        processed = agg.process_block(vb2, None, c)
+        assert sorted(k.offset for k in processed) == [0, 1]
+
+    def test_state_roundtrip(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM)
+        block = _block_with_shares(0, 8)
+        agg.process_block(block, None, c)
+        agg.vote(TransactionLocatorRange(block.reference, 0, 4), 1, c, [])
+        snapshot = agg.state()
+
+        restored = TransactionAggregator(QUORUM)
+        restored.with_state(snapshot)
+        restored.processed = set(agg.processed)
+        # one more vote certifies [0,4) in the restored copy too
+        out = []
+        restored.vote(TransactionLocatorRange(block.reference, 0, 4), 2, c, out)
+        assert sorted(k.offset for k in out) == [0, 1, 2, 3]
+
+
+class TestSharedRanges:
+    def test_contiguous_runs(self):
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        ref = genesis[0].reference
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis],
+            [Share(b"a"), Share(b"b"),
+             Vote(TransactionLocator(ref, 0)),
+             Share(b"c")],
+        )
+        ranges = shared_ranges(block)
+        assert [(r.offset_start_inclusive, r.offset_end_exclusive) for r in ranges] == [
+            (0, 2), (3, 4),
+        ]
+
+
+class TestVoteRangeBuilder:
+    def test_reference_sequence(self):
+        """committee.rs:530-541 vote_range_builder_test."""
+        b = VoteRangeBuilder()
+        assert b.add(1) is None
+        assert b.add(2) is None
+        assert b.add(4) == (1, 3)
+        assert b.add(6) == (4, 5)
+        assert b.finish() == (6, 7)
+
+    def test_empty(self):
+        assert VoteRangeBuilder().finish() is None

@@ -1,0 +1,96 @@
+"""RangeMap tests — property-based coverage mirroring range_map.rs's unit tests,
+checked against a naive dict-of-points model."""
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from mysticeti_tpu.range_map import RangeMap
+
+
+def set_range(rm, start, end, value):
+    rm.mutate_range(start, end, lambda s, e, old: value)
+
+
+class TestBasic:
+    def test_insert_and_get(self):
+        rm = RangeMap()
+        set_range(rm, 5, 10, "x")
+        assert rm.get(4) is None
+        assert rm.get(5) == "x"
+        assert rm.get(9) == "x"
+        assert rm.get(10) is None
+
+    def test_split_on_partial_overwrite(self):
+        rm = RangeMap()
+        set_range(rm, 0, 10, "a")
+        set_range(rm, 3, 6, "b")
+        assert [rm.get(i) for i in range(10)] == (
+            ["a"] * 3 + ["b"] * 3 + ["a"] * 4
+        )
+        assert len(rm) == 3
+
+    def test_delete_via_none(self):
+        rm = RangeMap()
+        set_range(rm, 0, 10, "a")
+        rm.mutate_range(2, 8, lambda s, e, old: None)
+        assert rm.get(1) == "a"
+        assert rm.get(5) is None
+        assert rm.get(9) == "a"
+
+    def test_gap_callback_sees_none(self):
+        rm = RangeMap()
+        set_range(rm, 5, 7, "a")
+        seen = []
+        rm.mutate_range(3, 9, lambda s, e, old: seen.append((s, e, old)) or old)
+        assert seen == [(3, 5, None), (5, 7, "a"), (7, 9, None)]
+
+    def test_multiple_fragments(self):
+        rm = RangeMap()
+        set_range(rm, 0, 2, "a")
+        set_range(rm, 4, 6, "b")
+        set_range(rm, 8, 10, "c")
+        seen = []
+        rm.mutate_range(1, 9, lambda s, e, old: seen.append((s, e, old)) or old)
+        assert seen == [
+            (1, 2, "a"), (2, 4, None), (4, 6, "b"), (6, 8, None), (8, 9, "c"),
+        ]
+
+    def test_empty_range_noop(self):
+        rm = RangeMap()
+        rm.mutate_range(5, 5, lambda s, e, old: "x")
+        assert rm.is_empty()
+
+
+@st.composite
+def operations(draw):
+    ops = []
+    for _ in range(draw(st.integers(0, 12))):
+        start = draw(st.integers(0, 30))
+        end = draw(st.integers(0, 30))
+        value = draw(st.one_of(st.none(), st.integers(0, 3)))
+        ops.append((start, end, value))
+    return ops
+
+
+class TestPropertyBased:
+    @settings(max_examples=200, deadline=None)
+    @given(operations())
+    def test_matches_point_model(self, ops):
+        rm = RangeMap()
+        model = {}
+        for start, end, value in ops:
+            rm.mutate_range(start, end, lambda s, e, old, v=value: v)
+            for k in range(start, end):
+                if value is None:
+                    model.pop(k, None)
+                else:
+                    model[k] = value
+        for k in range(0, 31):
+            assert rm.get(k) == model.get(k), f"mismatch at {k}"
+        # entries must be sorted, disjoint, non-empty
+        prev_end = None
+        for s, e, v in rm.items():
+            assert s < e
+            assert v is not None
+            if prev_end is not None:
+                assert s >= prev_end
+            prev_end = e

@@ -1,0 +1,50 @@
+"""Threshold clock tests — mirrors threshold_clock.rs:96-153."""
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.threshold_clock import (
+    ThresholdClockAggregator,
+    threshold_clock_valid_non_genesis,
+)
+from mysticeti_tpu.types import BlockReference
+from mysticeti_tpu.utils.dag import Dag
+
+
+def ref(authority, round_):
+    return BlockReference(authority, round_, bytes(32))
+
+
+class TestValidity:
+    def test_threshold_clock_valid(self):
+        committee = Committee.new_test([1, 1, 1, 1])
+        cases = [
+            ("A1:[]", False),
+            ("A1:[A0, B0]", False),
+            ("A1:[A0, B0, C0]", True),
+            ("A1:[A0, B0, C0, D0]", True),
+            ("A2:[A1, B1, C0, D0]", False),
+            ("A2:[A1, B1, C1, D0]", True),
+        ]
+        for dsl, expected in cases:
+            # rounds >1 need the included round-1 blocks drawn first
+            prefix = "A1:[A0,B0,C0]; B1:[A0,B0,C0]; C1:[A0,B0,C0]; "
+            block = Dag.draw(prefix + dsl).blocks[dsl.split(":")[0].strip()]
+            assert (
+                threshold_clock_valid_non_genesis(block, committee) is expected
+            ), dsl
+
+
+class TestAggregator:
+    def test_reference_sequence(self):
+        committee = Committee.new_test([1, 1, 1, 1])
+        agg = ThresholdClockAggregator(0)
+        agg.add_block(ref(0, 0), committee)
+        assert agg.get_round() == 0
+        agg.add_block(ref(0, 1), committee)
+        assert agg.get_round() == 1
+        agg.add_block(ref(1, 0), committee)
+        assert agg.get_round() == 1
+        agg.add_block(ref(1, 1), committee)
+        assert agg.get_round() == 1
+        agg.add_block(ref(2, 1), committee)
+        assert agg.get_round() == 2
+        agg.add_block(ref(3, 1), committee)
+        assert agg.get_round() == 2

@@ -1,0 +1,191 @@
+"""Tests for the DAG data model — mirrors types.rs:891-1035 coverage plus serde
+round-trips (bincode round-trips are implicit in the reference; our format is our own
+so it needs explicit tests)."""
+import pytest
+
+from mysticeti_tpu import crypto
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.serde import Reader, SerdeError, Writer
+from mysticeti_tpu.types import (
+    AuthoritySet,
+    BlockReference,
+    Share,
+    StatementBlock,
+    TransactionLocator,
+    TransactionLocatorRange,
+    VerificationError,
+    Vote,
+    VoteRange,
+)
+from mysticeti_tpu.utils.dag import Dag
+
+
+def make_ref(authority=0, round_=1, fill=0xAB):
+    return BlockReference(authority, round_, bytes([fill] * 32))
+
+
+class TestAuthoritySet:
+    def test_insert_contains(self):
+        s = AuthoritySet()
+        for i in (0, 1, 5, 63, 64, 127, 128, 511):
+            assert not s.contains(i)
+            assert s.insert(i)
+            assert s.contains(i)
+            assert not s.insert(i)  # duplicate returns False
+        assert sorted(s.present()) == [0, 1, 5, 63, 64, 127, 128, 511]
+        assert len(s) == 8
+
+    def test_max_size(self):
+        s = AuthoritySet()
+        with pytest.raises(ValueError):
+            s.insert(512)
+
+    def test_clear(self):
+        s = AuthoritySet()
+        s.insert(3)
+        s.clear()
+        assert not s.contains(3)
+        assert len(s) == 0
+
+
+class TestSerde:
+    def test_roundtrip_primitives(self):
+        w = Writer()
+        w.u8(7).u32(1 << 30).u64(1 << 60).bytes(b"hello").fixed(b"xy")
+        r = Reader(w.finish())
+        assert r.u8() == 7
+        assert r.u32() == 1 << 30
+        assert r.u64() == 1 << 60
+        assert r.bytes() == b"hello"
+        assert r.fixed(2) == b"xy"
+        r.expect_done()
+
+    def test_truncated(self):
+        r = Reader(b"\x01")
+        with pytest.raises(SerdeError):
+            r.u32()
+
+
+class TestStatementBlock:
+    def test_build_and_decode_roundtrip(self):
+        signer = crypto.Signer.from_seed(b"\x01" * 32)
+        parent = StatementBlock.new_genesis(1)
+        block = StatementBlock.build(
+            authority=0,
+            round_=1,
+            includes=[parent.reference],
+            statements=[
+                Share(b"tx-payload"),
+                Vote(TransactionLocator(parent.reference, 0)),
+                Vote(TransactionLocator(parent.reference, 1), accept=False),
+                VoteRange(TransactionLocatorRange(parent.reference, 2, 9)),
+            ],
+            meta_creation_time_ns=123456789,
+            signer=signer,
+        )
+        decoded = StatementBlock.from_bytes(block.to_bytes())
+        assert decoded.reference == block.reference
+        assert decoded.includes == block.includes
+        assert decoded.statements == block.statements
+        assert decoded.meta_creation_time_ns == 123456789
+        assert decoded.signature == block.signature
+        assert decoded.to_bytes() == block.to_bytes()
+
+    def test_digest_covers_signature(self):
+        """crypto.rs:77-84 layering: same content, different signer → different digest,
+        but identical signed_bytes prefix."""
+        s1 = crypto.Signer.from_seed(b"\x01" * 32)
+        s2 = crypto.Signer.from_seed(b"\x02" * 32)
+        b1 = StatementBlock.build(0, 1, (), (), signer=s1)
+        b2 = StatementBlock.build(0, 1, (), (), signer=s2)
+        assert b1.signed_bytes() == b2.signed_bytes()
+        assert b1.digest() != b2.digest()
+
+    def test_genesis_deterministic(self):
+        assert (
+            StatementBlock.new_genesis(2).reference
+            == StatementBlock.new_genesis(2).reference
+        )
+
+    def test_verify_good_block(self):
+        committee = Committee.new_for_benchmarks(4)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis], [Share(b"t")], signer=signers[0]
+        )
+        block.verify(committee)  # should not raise
+
+    def test_verify_bad_signature(self):
+        committee = Committee.new_for_benchmarks(4)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        # authority 0's block signed with authority 1's key
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis], (), signer=signers[1]
+        )
+        with pytest.raises(VerificationError, match="signature"):
+            block.verify(committee)
+
+    def test_verify_include_round_monotonicity(self):
+        committee = Committee.new_for_benchmarks(4)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        high = StatementBlock.build(
+            1, 5, [g.reference for g in genesis], (), signer=signers[1]
+        )
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis] + [high.reference], (),
+            signer=signers[0],
+        )
+        with pytest.raises(VerificationError, match="round"):
+            block.verify(committee)
+
+    def test_verify_threshold_clock(self):
+        committee = Committee.new_for_benchmarks(4)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        # only 2/4 includes at round 0 — below quorum
+        block = StatementBlock.build(
+            0, 1, [genesis[0].reference, genesis[1].reference], (), signer=signers[0]
+        )
+        with pytest.raises(VerificationError, match="[Tt]hreshold clock"):
+            block.verify(committee)
+
+    def test_verify_wrong_epoch(self):
+        committee = Committee.new_for_benchmarks(4, epoch=1)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis], (), epoch=0, signer=signers[0]
+        )
+        with pytest.raises(VerificationError, match="epoch"):
+            block.verify(committee)
+
+    def test_verify_tampered_payload(self):
+        committee = Committee.new_for_benchmarks(4)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis], [Share(b"AAAA")], signer=signers[0]
+        )
+        raw = bytearray(block.to_bytes())
+        idx = bytes(raw).index(b"AAAA")
+        raw[idx] = ord(b"B")
+        tampered = StatementBlock.from_bytes(bytes(raw))
+        # digest recomputes fine (covers tampered bytes) but the signature must fail
+        with pytest.raises(VerificationError, match="signature"):
+            tampered.verify(committee)
+
+
+class TestDagDsl:
+    def test_draw(self):
+        dag = Dag.draw("A1:[A0,B0,C0]; B1:[A0,B0,C0,D0]; A2:[A1,B1]")
+        assert len(dag) == 3 + 4  # three drawn + four implicit genesis
+        a2 = dag["A2"]
+        assert a2.author_round() == (0, 2)
+        assert a2.includes == (dag["A1"].reference, dag["B1"].reference)
+
+    def test_draw_undefined_ref(self):
+        with pytest.raises(ValueError, match="undefined"):
+            Dag.draw("A2:[A1]")
