@@ -96,9 +96,9 @@ class Committee:
     @classmethod
     def new_for_benchmarks(cls, size: int, epoch: Epoch = 0) -> "Committee":
         """Equal-stake committee with deterministic per-index keys (committee.rs:190-193)."""
-        signers = [crypto.Signer.from_seed(i.to_bytes(32, "little")) for i in range(size)]
         return cls(
-            [Authority(1, s.public_key) for s in signers], epoch,
+            [Authority(1, s.public_key) for s in cls.benchmark_signers(size)],
+            epoch,
             leader_election=STAKE_WEIGHTED,
         )
 
@@ -294,15 +294,25 @@ class TransactionAggregator:
         committee: Committee,
     ) -> None:
         """A block shared these transactions; start aggregation with the author's
-        implicit self-vote."""
+        implicit self-vote.
+
+        Handler violations (duplicate shares) are collected during the sweep and
+        raised only after the RangeMap update completes — raising mid-sweep would
+        leave ``pending`` partially mutated, and unlike the reference (which aborts
+        the process on these panics) a Python caller may catch and continue, so the
+        aggregator must stay internally consistent."""
         range_map = self.pending.setdefault(locator_range.block, RangeMap())
+        violations: List[Exception] = []
 
         def mutate(sub_start: int, sub_end: int, agg):
             if agg is not None:
                 for off in range(sub_start, sub_end):
-                    self.duplicate_transaction(
-                        TransactionLocator(locator_range.block, off), vote
-                    )
+                    try:
+                        self.duplicate_transaction(
+                            TransactionLocator(locator_range.block, off), vote
+                        )
+                    except Exception as e:  # noqa: BLE001 - deferred, re-raised below
+                        violations.append(e)
                 return agg
             new_agg = StakeAggregator(self.kind)
             new_agg.add(vote, committee)
@@ -313,6 +323,8 @@ class TransactionAggregator:
             locator_range.offset_end_exclusive,
             mutate,
         )
+        if violations:
+            raise violations[0]
 
     def vote(
         self,
@@ -326,13 +338,18 @@ class TransactionAggregator:
             for loc in locator_range.locators():
                 self.unknown_transaction(loc, vote)
             return
+        violations: List[Exception] = []
 
         def mutate(sub_start: int, sub_end: int, agg):
             if agg is None:
+                # Deferred like register(): keep the sweep atomic wrt `pending`.
                 for off in range(sub_start, sub_end):
-                    self.unknown_transaction(
-                        TransactionLocator(locator_range.block, off), vote
-                    )
+                    try:
+                        self.unknown_transaction(
+                            TransactionLocator(locator_range.block, off), vote
+                        )
+                    except Exception as e:  # noqa: BLE001 - deferred, re-raised below
+                        violations.append(e)
                 return None
             if agg.add(vote, committee):
                 for off in range(sub_start, sub_end):
@@ -349,6 +366,8 @@ class TransactionAggregator:
         )
         if range_map.is_empty():
             del self.pending[locator_range.block]
+        if violations:
+            raise violations[0]
 
     def process_block(
         self,
@@ -419,22 +438,19 @@ class TransactionAggregator:
 
 def shared_ranges(block: StatementBlock) -> List[TransactionLocatorRange]:
     """Contiguous runs of Share statements in a block as locator ranges
-    (types.rs shared_ranges equivalent used by committee.rs:455)."""
-    ranges: List[TransactionLocatorRange] = []
-    start: Optional[int] = None
+    (types.rs shared_ranges equivalent used by committee.rs:455); run-length
+    compression delegated to VoteRangeBuilder so there is one copy of that logic."""
+    builder = VoteRangeBuilder()
+    runs: List[Tuple[int, int]] = []
     for i, st in enumerate(block.statements):
         if isinstance(st, Share):
-            if start is None:
-                start = i
-        else:
-            if start is not None:
-                ranges.append(TransactionLocatorRange(block.reference, start, i))
-                start = None
-    if start is not None:
-        ranges.append(
-            TransactionLocatorRange(block.reference, start, len(block.statements))
-        )
-    return ranges
+            done = builder.add(i)
+            if done is not None:
+                runs.append(done)
+    tail = builder.finish()
+    if tail is not None:
+        runs.append(tail)
+    return [TransactionLocatorRange(block.reference, s, e) for s, e in runs]
 
 
 class VoteRangeBuilder:

@@ -75,6 +75,11 @@ class TransactionLocator:
         return TransactionLocator(BlockReference.decode(r), r.u64())
 
 
+# Upper bound on vote-range extent; a Byzantine block must not be able to make a
+# validator iterate an unbounded range (reference caps at 1M, types.rs range verify).
+LOCATOR_RANGE_MAX_LEN = 1 << 20
+
+
 @dataclass(frozen=True, order=True)
 class TransactionLocatorRange:
     """Half-open offset range of transactions within one block (types.rs:389-394)."""
@@ -88,6 +93,16 @@ class TransactionLocatorRange:
             raise SerdeError(
                 f"invalid locator range: end {self.offset_end_exclusive} < "
                 f"start {self.offset_start_inclusive}"
+            )
+        # direct arithmetic: __len__ cannot represent >ssize_t ranges
+        if self.offset_end_exclusive - self.offset_start_inclusive > LOCATOR_RANGE_MAX_LEN:
+            raise SerdeError(
+                f"locator range too long: "
+                f"{self.offset_end_exclusive - self.offset_start_inclusive}"
+            )
+        if self.offset_end_exclusive > LOCATOR_RANGE_MAX_LEN:
+            raise SerdeError(
+                f"locator range end too large: {self.offset_end_exclusive}"
             )
 
     def locators(self) -> Iterator[TransactionLocator]:
@@ -125,11 +140,18 @@ class Share:
 
 @dataclass(frozen=True)
 class Vote:
-    """Authority votes to accept or reject a transaction (types.rs:30-34,60-61)."""
+    """Authority votes to accept or reject a transaction (types.rs:30-34,60-61).
+
+    ``conflict`` (the competing locator of a Reject) is only meaningful on reject
+    votes; carrying one on an accept would be silently unencodable."""
 
     locator: TransactionLocator
     accept: bool = True
     conflict: Optional[TransactionLocator] = None  # Reject(Option<locator>)
+
+    def __post_init__(self) -> None:
+        if self.accept and self.conflict is not None:
+            raise ValueError("accept votes cannot carry a conflict locator")
 
 
 @dataclass(frozen=True)
@@ -166,10 +188,17 @@ def decode_statement(r: Reader) -> BaseStatement:
         return Share(r.bytes())
     if tag == _ST_VOTE:
         locator = TransactionLocator.decode(r)
-        accept = r.u8() == VOTE_ACCEPT
+        vote_byte = r.u8()
+        if vote_byte not in (VOTE_ACCEPT, VOTE_REJECT):
+            raise SerdeError(f"invalid vote byte {vote_byte}")
+        accept = vote_byte == VOTE_ACCEPT
         conflict = None
-        if not accept and r.u8() == 1:
-            conflict = TransactionLocator.decode(r)
+        if not accept:
+            presence = r.u8()
+            if presence not in (0, 1):
+                raise SerdeError(f"invalid conflict-presence byte {presence}")
+            if presence == 1:
+                conflict = TransactionLocator.decode(r)
         return Vote(locator, accept, conflict)
     if tag == _ST_VOTE_RANGE:
         rng = TransactionLocatorRange.decode(r)

@@ -178,6 +178,55 @@ class TestStatementBlock:
             tampered.verify(committee)
 
 
+class TestVoteRangeBounds:
+    def test_unbounded_range_rejected(self):
+        """A Byzantine block must not induce iteration over 2^64 offsets."""
+        committee = Committee.new_for_benchmarks(4)
+        signers = Committee.benchmark_signers(4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        block = StatementBlock.build(
+            0, 1, [g.reference for g in genesis],
+            [VoteRange(TransactionLocatorRange(genesis[0].reference, 0, 2**63))],
+            signer=signers[0],
+        )
+        with pytest.raises(SerdeError, match="too"):
+            block.verify(committee)
+
+    def test_reasonable_range_ok(self):
+        rng = TransactionLocatorRange(make_ref(), 0, 10000)
+        rng.verify()
+
+
+class TestVoteStrictness:
+    def test_accept_with_conflict_rejected(self):
+        with pytest.raises(ValueError, match="conflict"):
+            Vote(TransactionLocator(make_ref(), 0), accept=True,
+                 conflict=TransactionLocator(make_ref(), 1))
+
+    def test_reject_conflict_roundtrip(self):
+        block = StatementBlock.build(
+            0, 1, (), [Vote(TransactionLocator(make_ref(), 0), accept=False,
+                            conflict=TransactionLocator(make_ref(1), 7))],
+        )
+        decoded = StatementBlock.from_bytes(block.to_bytes())
+        assert decoded.statements == block.statements
+        assert decoded.statements[0].conflict.offset == 7
+
+    def test_invalid_vote_byte_rejected(self):
+        """Non-canonical wire bytes must raise, not silently coerce."""
+        block = StatementBlock.build(
+            0, 1, (), [Vote(TransactionLocator(make_ref(), 0), accept=True)],
+        )
+        raw = bytearray(block.to_bytes())
+        # vote byte sits right after: u64 auth + u64 round + u32 n_inc + u32 n_st
+        # + u8 tag + (u64+u64+32 digest) locator + u64 offset
+        vote_byte_idx = 8 + 8 + 4 + 4 + 1 + (8 + 8 + 32) + 8
+        assert raw[vote_byte_idx] == 0  # VOTE_ACCEPT
+        raw[vote_byte_idx] = 2
+        with pytest.raises(SerdeError, match="vote byte"):
+            StatementBlock.from_bytes(bytes(raw))
+
+
 class TestDagDsl:
     def test_draw(self):
         dag = Dag.draw("A1:[A0,B0,C0]; B1:[A0,B0,C0,D0]; A2:[A1,B1]")
