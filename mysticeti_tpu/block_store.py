@@ -1,0 +1,376 @@
+"""Round-indexed block store over the WAL, with recovery replay.
+
+Capability parity with ``mysticeti-core/src/block_store.rs``:
+
+* index: round -> {(authority, digest) -> IndexEntry}, loaded/unloaded cache states
+  (block_store.rs:28-47)
+* ``BlockStore.open`` — WAL replay feeding a ``RecoveredStateBuilder`` (block_store.rs:50-116)
+* DAG queries: ``get_blocks_by_round`` (:129), ``get_blocks_at_authority_round`` (:134),
+  existence checks (:146-178), ancestry ``linked`` / ``linked_to_round`` (:284-327)
+* dissemination cursors ``get_own_blocks`` / ``get_others_blocks`` (:220-240,434-476)
+* cache eviction ``cleanup`` -> ``unload_below_round`` (:207-218,374-396)
+* ``BlockWriter`` write-through (:38-41,504-518); ``OwnBlockData`` framing
+  {next_entry, block} (:521-550); serializable ``CommitData`` (:552-573)
+* WAL entry tags (:496-502)
+
+Design notes: a single ``threading.RLock`` replaces the reference's parking_lot
+RwLock — mutation comes only from the consensus owner task, readers may be the
+metrics reporter or the dissemination tasks.  ``IndexEntry`` is a ``(position,
+block-or-None)`` tuple rather than an enum; ``None`` means unloaded (read back
+through the WAL mmap on demand).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .serde import Reader, Writer
+from .types import (
+    AuthorityIndex,
+    BlockReference,
+    RoundNumber,
+    Share,
+    StatementBlock,
+    TransactionLocator,
+)
+from .wal import POSITION_MAX, Tag, WalPosition, WalReader, WalWriter
+
+WAL_ENTRY_BLOCK: Tag = 1
+WAL_ENTRY_PAYLOAD: Tag = 2
+WAL_ENTRY_OWN_BLOCK: Tag = 3
+WAL_ENTRY_STATE: Tag = 4
+# Commit entry carries both the linearizer's incremental state and the committed
+# transaction-aggregator state (block_store.rs:500-502).
+WAL_ENTRY_COMMIT: Tag = 5
+
+_OWN_BLOCK_HEADER_SIZE = 8  # u64 next_entry (block_store.rs:526)
+
+# IndexEntry: (wal position, loaded block or None)
+IndexEntry = Tuple[WalPosition, Optional[StatementBlock]]
+
+
+@dataclass
+class OwnBlockData:
+    """Own proposal + the WAL cursor past consumed pending entries (block_store.rs:521-550)."""
+
+    next_entry: WalPosition
+    block: StatementBlock
+
+    def to_bytes(self) -> bytes:
+        return self.next_entry.to_bytes(8, "little") + self.block.to_bytes()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "OwnBlockData":
+        next_entry = int.from_bytes(data[:_OWN_BLOCK_HEADER_SIZE], "little")
+        block = StatementBlock.from_bytes(data[_OWN_BLOCK_HEADER_SIZE:])
+        return OwnBlockData(next_entry, block)
+
+    def write_to_wal(self, writer: WalWriter) -> WalPosition:
+        header = self.next_entry.to_bytes(8, "little")
+        return writer.writev(WAL_ENTRY_OWN_BLOCK, (header, self.block.to_bytes()))
+
+
+@dataclass
+class CommitData:
+    """Serializable CommittedSubDag: anchor + all block refs + height (block_store.rs:552-573)."""
+
+    leader: BlockReference
+    sub_dag: List[BlockReference]
+    height: int
+
+    def encode(self, w: Writer) -> None:
+        self.leader.encode(w)
+        w.u32(len(self.sub_dag))
+        for ref in self.sub_dag:
+            ref.encode(w)
+        w.u64(self.height)
+
+    @staticmethod
+    def decode(r: Reader) -> "CommitData":
+        leader = BlockReference.decode(r)
+        sub_dag = [BlockReference.decode(r) for _ in range(r.u32())]
+        return CommitData(leader, sub_dag, r.u64())
+
+
+class BlockStore:
+    """The DAG index.  Cheap to share (all methods take the internal lock)."""
+
+    def __init__(
+        self,
+        authority: AuthorityIndex,
+        num_authorities: int,
+        wal_reader: WalReader,
+        metrics=None,
+    ) -> None:
+        self._lock = threading.RLock()
+        self._index: Dict[
+            RoundNumber, Dict[Tuple[AuthorityIndex, bytes], IndexEntry]
+        ] = {}
+        self._own_blocks: Dict[RoundNumber, bytes] = {}
+        self._highest_round: RoundNumber = 0
+        self._authority = authority
+        self._last_seen_by_authority: List[RoundNumber] = [0] * num_authorities
+        self._last_own_block: Optional[BlockReference] = None
+        self._wal_reader = wal_reader
+        self._metrics = metrics
+
+    # -- recovery (block_store.rs:50-116) --
+
+    @classmethod
+    def open(
+        cls,
+        authority: AuthorityIndex,
+        wal_reader: WalReader,
+        wal_writer: WalWriter,
+        committee,
+        metrics=None,
+    ):
+        """Replay the WAL, building the index and the recovered core/observer state.
+
+        Returns ``(CoreRecoveredState, CommitObserverRecoveredState)``; the block
+        store itself rides inside the core state (state.rs:72-94).
+        """
+        from .state import RecoveredStateBuilder
+
+        store = cls(authority, len(committee), wal_reader, metrics)
+        builder = RecoveredStateBuilder()
+        for pos, tag, payload in wal_reader.iter_until(wal_writer.position()):
+            if tag == WAL_ENTRY_BLOCK:
+                block = StatementBlock.from_bytes(payload)
+                builder.block(pos, block)
+            elif tag == WAL_ENTRY_PAYLOAD:
+                builder.payload(pos, payload)
+                continue
+            elif tag == WAL_ENTRY_OWN_BLOCK:
+                own = OwnBlockData.from_bytes(payload)
+                builder.own_block(own)
+                block = own.block
+            elif tag == WAL_ENTRY_STATE:
+                builder.state(payload)
+                continue
+            elif tag == WAL_ENTRY_COMMIT:
+                r = Reader(payload)
+                commits = [CommitData.decode(r) for _ in range(r.u32())]
+                committed_state = r.bytes()
+                r.expect_done()
+                builder.commit_data(commits, committed_state)
+                continue
+            else:
+                raise ValueError(f"unknown wal tag {tag} at position {pos}")
+            store._add_unloaded(block.reference, pos)
+        return builder.build(store)
+
+    # -- writes --
+
+    def insert_block(self, block: StatementBlock, position: WalPosition) -> None:
+        with self._lock:
+            self._highest_round = max(self._highest_round, block.round())
+            self._add_own_index(block.reference)
+            self._update_last_seen(block.reference)
+            self._index.setdefault(block.round(), {})[
+                (block.author(), block.digest())
+            ] = (position, block)
+
+    def _add_unloaded(self, reference: BlockReference, position: WalPosition) -> None:
+        self._highest_round = max(self._highest_round, reference.round)
+        self._add_own_index(reference)
+        self._update_last_seen(reference)
+        self._index.setdefault(reference.round, {})[
+            (reference.authority, reference.digest)
+        ] = (position, None)
+
+    def _add_own_index(self, reference: BlockReference) -> None:
+        if reference.authority != self._authority:
+            return
+        last = self._last_own_block.round if self._last_own_block else 0
+        if reference.round > last:
+            self._last_own_block = reference
+        if reference.round in self._own_blocks:
+            raise ValueError(f"duplicate own block for round {reference.round}")
+        self._own_blocks[reference.round] = reference.digest
+
+    def _update_last_seen(self, reference: BlockReference) -> None:
+        if reference.authority < len(self._last_seen_by_authority):
+            if reference.round > self._last_seen_by_authority[reference.authority]:
+                self._last_seen_by_authority[reference.authority] = reference.round
+
+    # -- entry loading --
+
+    def _load(self, entry: IndexEntry) -> StatementBlock:
+        position, block = entry
+        if block is not None:
+            return block
+        if self._metrics is not None:
+            self._metrics.block_store_loaded_blocks.inc()
+        tag, payload = self._wal_reader.read(position)
+        if tag == WAL_ENTRY_BLOCK:
+            return StatementBlock.from_bytes(payload)
+        if tag == WAL_ENTRY_OWN_BLOCK:
+            return OwnBlockData.from_bytes(payload).block
+        raise ValueError(f"index entry at {position} has non-block tag {tag}")
+
+    # -- queries --
+
+    def get_block(self, reference: BlockReference) -> Optional[StatementBlock]:
+        with self._lock:
+            entry = self._index.get(reference.round, {}).get(
+                (reference.authority, reference.digest)
+            )
+        return self._load(entry) if entry is not None else None
+
+    def block_exists(self, reference: BlockReference) -> bool:
+        with self._lock:
+            return (reference.authority, reference.digest) in self._index.get(
+                reference.round, {}
+            )
+
+    def get_blocks_by_round(self, round_: RoundNumber) -> List[StatementBlock]:
+        with self._lock:
+            entries = list(self._index.get(round_, {}).values())
+        return [self._load(e) for e in entries]
+
+    def get_blocks_at_authority_round(
+        self, authority: AuthorityIndex, round_: RoundNumber
+    ) -> List[StatementBlock]:
+        with self._lock:
+            entries = [
+                e
+                for (a, _), e in self._index.get(round_, {}).items()
+                if a == authority
+            ]
+        return [self._load(e) for e in entries]
+
+    def block_exists_at_authority_round(
+        self, authority: AuthorityIndex, round_: RoundNumber
+    ) -> bool:
+        with self._lock:
+            return any(a == authority for (a, _) in self._index.get(round_, {}))
+
+    def all_blocks_exists_at_authority_round(
+        self, authorities: Sequence[AuthorityIndex], round_: RoundNumber
+    ) -> bool:
+        with self._lock:
+            present = {a for (a, _) in self._index.get(round_, {})}
+        return all(a in present for a in authorities)
+
+    def get_transaction(self, locator: TransactionLocator) -> Optional[bytes]:
+        block = self.get_block(locator.block)
+        if block is None or locator.offset >= len(block.statements):
+            return None
+        st = block.statements[locator.offset]
+        return st.transaction if isinstance(st, Share) else None
+
+    def len_expensive(self) -> int:
+        with self._lock:
+            return sum(len(m) for m in self._index.values())
+
+    def highest_round(self) -> RoundNumber:
+        with self._lock:
+            return self._highest_round
+
+    def last_seen_by_authority(self, authority: AuthorityIndex) -> RoundNumber:
+        with self._lock:
+            return self._last_seen_by_authority[authority]
+
+    def last_own_block_ref(self) -> Optional[BlockReference]:
+        with self._lock:
+            return self._last_own_block
+
+    def authority(self) -> AuthorityIndex:
+        return self._authority
+
+    # -- dissemination cursors (block_store.rs:220-240,434-476) --
+
+    def get_own_blocks(
+        self, from_excluded: RoundNumber, limit: int
+    ) -> List[StatementBlock]:
+        with self._lock:
+            rounds = sorted(r for r in self._own_blocks if r > from_excluded)[:limit]
+            entries = [
+                self._index[r][(self._authority, self._own_blocks[r])] for r in rounds
+            ]
+        return [self._load(e) for e in entries]
+
+    def get_others_blocks(
+        self, from_excluded: RoundNumber, authority: AuthorityIndex, limit: int
+    ) -> List[StatementBlock]:
+        with self._lock:
+            entries: List[IndexEntry] = []
+            for r in sorted(r for r in self._index if r > from_excluded):
+                if len(entries) >= limit:
+                    break
+                for (a, _), e in self._index[r].items():
+                    if a == authority:
+                        entries.append(e)
+            entries = entries[:limit]
+        return [self._load(e) for e in entries]
+
+    # -- ancestry (block_store.rs:284-327) --
+
+    def linked(self, later: StatementBlock, earlier: StatementBlock) -> bool:
+        """Is ``earlier`` an ancestor of ``later``?  Round-by-round frontier walk."""
+        parents = [later]
+        for r in range(later.round() - 1, earlier.round() - 1, -1):
+            parent_refs = {inc for p in parents for inc in p.includes}
+            parents = [
+                b for b in self.get_blocks_by_round(r) if b.reference in parent_refs
+            ]
+        return earlier in parents
+
+    def linked_to_round(
+        self, later: StatementBlock, earlier_round: RoundNumber
+    ) -> List[StatementBlock]:
+        """All ancestors of ``later`` at ``earlier_round`` reachable via includes."""
+        parents = [later]
+        for r in range(later.round() - 1, earlier_round - 1, -1):
+            parent_refs = {inc for p in parents for inc in p.includes}
+            parents = [
+                b for b in self.get_blocks_by_round(r) if b.reference in parent_refs
+            ]
+            if not parents:
+                break
+        return parents
+
+    # -- cache eviction (block_store.rs:207-218,374-396) --
+
+    def cleanup(self, threshold_round: RoundNumber) -> int:
+        if threshold_round == 0:
+            return 0
+        unloaded = 0
+        with self._lock:
+            for round_, m in self._index.items():
+                if round_ > threshold_round:
+                    continue
+                for key, (pos, block) in m.items():
+                    if block is not None:
+                        m[key] = (pos, None)
+                        unloaded += 1
+        self._wal_reader.cleanup()
+        if self._metrics is not None and unloaded:
+            self._metrics.block_store_unloaded_blocks.inc(unloaded)
+        return unloaded
+
+
+class BlockWriter:
+    """Write-through of blocks to WAL + index (block_store.rs:504-518).
+
+    The reference implements this as a trait on ``(&mut WalWriter, &BlockStore)``;
+    here it is a tiny binding object constructed wherever both halves are in hand.
+    """
+
+    __slots__ = ("wal_writer", "block_store")
+
+    def __init__(self, wal_writer: WalWriter, block_store: BlockStore) -> None:
+        self.wal_writer = wal_writer
+        self.block_store = block_store
+
+    def insert_block(self, block: StatementBlock) -> WalPosition:
+        pos = self.wal_writer.write(WAL_ENTRY_BLOCK, block.to_bytes())
+        self.block_store.insert_block(block, pos)
+        return pos
+
+    def insert_own_block(self, data: OwnBlockData) -> WalPosition:
+        pos = data.write_to_wal(self.wal_writer)
+        self.block_store.insert_block(data.block, pos)
+        return pos

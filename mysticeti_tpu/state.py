@@ -1,0 +1,141 @@
+"""Crash-recovery state folding: WAL replay -> core + commit-observer state.
+
+Capability parity with ``mysticeti-core/src/state.rs``:
+
+* ``CoreRecoveredState``  (state.rs:13-20) — block store, last own block, pending
+  proposal queue, handler state snapshot, blocks to re-run through the handler,
+  last committed leader.
+* ``CommitObserverRecoveredState`` (commit_observer.rs) — committed sub-dags +
+  committed-transaction aggregator state.
+* ``RecoveredStateBuilder`` (state.rs:23-95) — folds the five WAL entry kinds:
+  block/payload entries accumulate into the pending queue; an own-block entry
+  drops every pending entry before its ``next_entry`` cursor (those were consumed
+  by that proposal, state.rs:49-54); a state snapshot clears the unprocessed-block
+  replay list (state.rs:56-59); commit entries track commit history + state.
+
+``MetaStatement`` (core.rs:61-65) lives here so both ``core`` and this module can
+use it without a cycle: Include(reference) | Payload(list-of-statements).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple, Union
+
+from collections import deque
+
+from .block_store import CommitData, OwnBlockData
+from .serde import Reader, Writer
+from .types import BaseStatement, BlockReference, StatementBlock, decode_statement, encode_statement
+from .wal import WalPosition
+
+
+@dataclass(frozen=True)
+class Include:
+    """Pending reference to another authority's block (core.rs:63)."""
+
+    reference: BlockReference
+
+
+@dataclass(frozen=True)
+class Payload:
+    """Pending own statements produced by the block handler (core.rs:64)."""
+
+    statements: Tuple[BaseStatement, ...]
+
+
+MetaStatement = Union[Include, Payload]
+
+
+def encode_payload(statements) -> bytes:
+    w = Writer()
+    w.u32(len(statements))
+    for st in statements:
+        encode_statement(w, st)
+    return w.finish()
+
+
+def decode_payload(data: bytes) -> Tuple[BaseStatement, ...]:
+    r = Reader(data)
+    statements = tuple(decode_statement(r) for _ in range(r.u32()))
+    r.expect_done()
+    return statements
+
+
+@dataclass
+class CoreRecoveredState:
+    """Everything ``Core.open`` needs to resume exactly where the crash left off."""
+
+    block_store: object  # BlockStore (untyped to avoid cycle)
+    last_own_block: Optional[OwnBlockData]
+    pending: Deque[Tuple[WalPosition, MetaStatement]]
+    state: Optional[bytes]
+    unprocessed_blocks: List[StatementBlock]
+    last_committed_leader: Optional[BlockReference]
+
+
+@dataclass
+class CommitObserverRecoveredState:
+    sub_dags: List[CommitData] = field(default_factory=list)
+    state: Optional[bytes] = None
+
+
+class RecoveredStateBuilder:
+    """Folds WAL replay entries in log order (state.rs:23-95)."""
+
+    def __init__(self) -> None:
+        # position -> raw meta statement; kept sorted by insertion (wal order).
+        self._pending: Dict[WalPosition, MetaStatement] = {}
+        self._last_own_block: Optional[OwnBlockData] = None
+        self._state: Optional[bytes] = None
+        self._unprocessed_blocks: List[StatementBlock] = []
+        self._last_committed_leader: Optional[BlockReference] = None
+        self._committed_sub_dags: List[CommitData] = []
+        self._committed_state: Optional[bytes] = None
+
+    def block(self, pos: WalPosition, block: StatementBlock) -> None:
+        self._pending[pos] = Include(block.reference)
+        self._unprocessed_blocks.append(block)
+
+    def payload(self, pos: WalPosition, payload: bytes) -> None:
+        self._pending[pos] = Payload(decode_payload(payload))
+
+    def own_block(self, own: OwnBlockData) -> None:
+        # Drop pending entries the proposal already consumed (state.rs:49-54);
+        # next_entry == POSITION_MAX drops everything.
+        self._pending = {
+            pos: st for pos, st in self._pending.items() if pos >= own.next_entry
+        }
+        self._unprocessed_blocks.append(own.block)
+        self._last_own_block = own
+
+    def state(self, state: bytes) -> None:
+        self._state = state
+        self._unprocessed_blocks.clear()
+
+    def commit_data(self, commits: List[CommitData], committed_state: bytes) -> None:
+        for commit in commits:
+            self._last_committed_leader = commit.leader
+            if self._committed_sub_dags:
+                assert commit.height > self._committed_sub_dags[-1].height
+            self._committed_sub_dags.append(commit)
+        self._committed_state = committed_state
+
+    def build(
+        self, block_store
+    ) -> Tuple[CoreRecoveredState, CommitObserverRecoveredState]:
+        pending: Deque[Tuple[WalPosition, MetaStatement]] = deque(
+            sorted(self._pending.items())
+        )
+        core = CoreRecoveredState(
+            block_store=block_store,
+            last_own_block=self._last_own_block,
+            pending=pending,
+            state=self._state,
+            unprocessed_blocks=self._unprocessed_blocks,
+            last_committed_leader=self._last_committed_leader,
+        )
+        observer = CommitObserverRecoveredState(
+            sub_dags=self._committed_sub_dags,
+            state=self._committed_state,
+        )
+        return core, observer

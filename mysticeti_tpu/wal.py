@@ -1,0 +1,216 @@
+"""Write-ahead log: append-only tagged entries with crc32 framing and mmap reads.
+
+Capability parity with ``mysticeti-core/src/wal.rs``:
+
+* ``walf(path) -> (WalWriter, WalReader)``                      (wal.rs:38-41)
+* 16-byte entry header (magic, crc32, len, tag)                  (wal.rs:110-112,211-223)
+* positional addressing: a ``WalPosition`` is the byte offset of the entry header,
+  ``POSITION_MAX`` is the reserved "none" position                (wal.rs:31-36)
+* reads return memory-mapped views                               (wal.rs:226-259)
+* ``iter_until`` replay iterator used for crash recovery         (wal.rs:270-293)
+* ``WalSyncer`` — handle for lock-free fsync from a separate thread (wal.rs:199-208)
+* ``MAX_ENTRY_SIZE`` bound                                       (wal.rs:107)
+
+Design notes (new implementation, not a port): the reference manages 16 MiB
+map-aligned windows and pads entries so they never straddle a window
+(wal.rs:96-104).  Here the reader maps the whole file and remaps lazily as it
+grows, which gives the same zero-copy property without padding logic; the writer
+issues unbuffered ``os.write`` so entries become visible to the reader (via page
+cache) immediately, and ``sync`` / ``WalSyncer.sync`` force durability.  A torn
+tail entry (crash mid-write) fails its crc and cleanly terminates replay.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import threading
+import zlib
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+Tag = int
+WalPosition = int
+
+_HEADER = struct.Struct("<IIII")  # magic, crc32(payload), payload len, tag
+HEADER_SIZE = _HEADER.size
+WAL_MAGIC = 0x314C4157  # b"WAL1" little-endian
+POSITION_MAX: WalPosition = (1 << 64) - 1
+MAX_ENTRY_SIZE = 64 * 1024 * 1024  # bound on a single entry payload
+
+
+class WalError(IOError):
+    """Corrupt or inconsistent WAL content."""
+
+
+def walf(path: str) -> Tuple["WalWriter", "WalReader"]:
+    """Open (creating if needed) the log at ``path`` (wal.rs:38-50)."""
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    size = os.fstat(fd).st_size
+    writer = WalWriter(fd, size, path)
+    reader = WalReader(path)
+    return writer, reader
+
+
+class WalWriter:
+    """Single-owner appender.  Not thread-safe by design: all writes come from the
+    consensus owner task (the reference's single core thread, core_thread/spawned.rs)."""
+
+    __slots__ = ("_fd", "_pos", "_path", "_closed")
+
+    def __init__(self, fd: int, pos: int, path: str) -> None:
+        self._fd = fd
+        self._pos = pos
+        self._path = path
+        self._closed = False
+        os.lseek(fd, 0, os.SEEK_END)  # append after any recovered content
+
+    def write(self, tag: Tag, payload: bytes) -> WalPosition:
+        return self.writev(tag, (payload,))
+
+    def writev(self, tag: Tag, parts: Sequence[bytes]) -> WalPosition:
+        """Append one entry assembled from ``parts`` (scatter write, wal.rs:150-198)."""
+        assert not self._closed
+        length = sum(len(p) for p in parts)
+        if length > MAX_ENTRY_SIZE:
+            raise WalError(f"entry of {length} bytes exceeds MAX_ENTRY_SIZE")
+        crc = 0
+        for p in parts:
+            crc = zlib.crc32(p, crc)
+        header = _HEADER.pack(WAL_MAGIC, crc, length, tag)
+        position = self._pos
+        os.writev(self._fd, [header, *parts])
+        self._pos = position + HEADER_SIZE + length
+        return position
+
+    def position(self) -> WalPosition:
+        return self._pos
+
+    def sync(self) -> None:
+        os.fsync(self._fd)
+
+    def syncer(self) -> "WalSyncer":
+        """An independently-owned fsync handle usable from another thread (wal.rs:199-208)."""
+        return WalSyncer(self._path)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
+
+
+class WalSyncer:
+    """Fsync handle decoupled from the writer: owns its own descriptor so a
+    dedicated flusher thread never contends with the appender (wal.rs:199-208,
+    used by net_sync.rs:496-560's AsyncWalSyncer)."""
+
+    __slots__ = ("_fd",)
+
+    def __init__(self, path: str) -> None:
+        self._fd = os.open(path, os.O_RDWR)
+
+    def sync(self) -> None:
+        os.fsync(self._fd)
+
+    def close(self) -> None:
+        os.close(self._fd)
+
+
+class WalReader:
+    """Random-access reader over the log; thread-safe.
+
+    Reads go through a whole-file mmap that is lazily re-created when the file has
+    grown past the mapped size (the reference's analogue: 16 MiB windows mapped on
+    demand, wal.rs:96-104,226-259).  ``cleanup`` drops the mapping so the OS can
+    reclaim page cache (wal.rs:302-311 equivalent).
+    """
+
+    __slots__ = ("_fd", "_map", "_map_size", "_lock", "_path")
+
+    def __init__(self, path: str) -> None:
+        self._fd = os.open(path, os.O_RDONLY)
+        self._path = path
+        self._map: Optional[mmap.mmap] = None
+        self._map_size = 0
+        self._lock = threading.Lock()
+
+    # -- mapping management --
+
+    def _ensure_mapped(self, end: int) -> Optional[mmap.mmap]:
+        """Map at least [0, end); returns None if the file is still shorter than end."""
+        with self._lock:
+            if self._map is not None and end <= self._map_size:
+                return self._map
+            size = os.fstat(self._fd).st_size
+            if end > size:
+                return None
+            if self._map is not None:
+                self._map.close()
+            self._map = mmap.mmap(self._fd, size, prot=mmap.PROT_READ)
+            self._map_size = size
+            return self._map
+
+    def cleanup(self) -> int:
+        """Drop the current mapping; returns number of retained maps (0/1)."""
+        with self._lock:
+            if self._map is not None:
+                self._map.close()
+                self._map = None
+                self._map_size = 0
+        return 0
+
+    # -- reads --
+
+    def _read_header(self, position: WalPosition) -> Optional[Tuple[int, int, Tag]]:
+        m = self._ensure_mapped(position + HEADER_SIZE)
+        if m is None:
+            return None
+        magic, crc, length, tag = _HEADER.unpack_from(m, position)
+        if magic != WAL_MAGIC:
+            return None
+        return crc, length, tag
+
+    def read(self, position: WalPosition) -> Tuple[Tag, bytes]:
+        """Read the entry at ``position``; raises WalError on corruption (wal.rs:226-259)."""
+        header = self._read_header(position)
+        if header is None:
+            raise WalError(f"no valid wal entry at position {position}")
+        crc, length, tag = header
+        m = self._ensure_mapped(position + HEADER_SIZE + length)
+        if m is None:
+            raise WalError(f"truncated wal entry at position {position}")
+        payload = bytes(
+            memoryview(m)[position + HEADER_SIZE : position + HEADER_SIZE + length]
+        )
+        if zlib.crc32(payload) != crc:
+            raise WalError(f"crc mismatch at position {position}")
+        return tag, payload
+
+    def iter_until(
+        self, end: Optional[WalPosition] = None
+    ) -> Iterator[Tuple[WalPosition, Tag, bytes]]:
+        """Replay all entries from the start up to ``end`` (or the current file end).
+
+        A torn/corrupt tail entry terminates iteration silently — that is the
+        crash-recovery contract (wal.rs:270-293): everything before the tear was
+        durable, the tear itself was never acknowledged.
+        """
+        pos: WalPosition = 0
+        if end is None:
+            end = os.fstat(self._fd).st_size
+        while pos + HEADER_SIZE <= end:
+            header = self._read_header(pos)
+            if header is None:
+                return
+            crc, length, tag = header
+            if pos + HEADER_SIZE + length > end:
+                return
+            try:
+                tag2, payload = self.read(pos)
+            except WalError:
+                return
+            yield pos, tag2, payload
+            pos += HEADER_SIZE + length
+
+    def close(self) -> None:
+        self.cleanup()
+        os.close(self._fd)

@@ -1,0 +1,173 @@
+"""Block store tests — index/recovery/ancestry parity with block_store.rs:575-647."""
+import pytest
+
+from mysticeti_tpu.block_store import (
+    BlockStore,
+    BlockWriter,
+    CommitData,
+    OwnBlockData,
+    WAL_ENTRY_COMMIT,
+    WAL_ENTRY_PAYLOAD,
+    WAL_ENTRY_STATE,
+)
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.serde import Writer
+from mysticeti_tpu.state import Include, Payload, encode_payload
+from mysticeti_tpu.types import Share, StatementBlock
+from mysticeti_tpu.utils.dag import Dag
+from mysticeti_tpu.wal import POSITION_MAX, walf
+
+
+@pytest.fixture
+def committee():
+    return Committee.new_test([1, 1, 1, 1])
+
+
+def open_store(tmp_path, committee, authority=0, name="wal"):
+    w, r = walf(str(tmp_path / name))
+    core, observer = BlockStore.open(authority, r, w, committee)
+    return w, r, core, observer
+
+
+def test_insert_and_queries(tmp_path, committee):
+    w, _r, core, _obs = open_store(tmp_path, committee)
+    store = core.block_store
+    writer = BlockWriter(w, store)
+    dag = Dag.draw("A1:[A0,B0,C0]; B1:[A0,B0,C0]; A2:[A1,B1]")
+    for blk in dag.all_blocks():
+        writer.insert_block(blk)
+
+    a1 = dag["A1"]
+    assert store.block_exists(a1.reference)
+    assert store.get_block(a1.reference) == a1
+    assert store.highest_round() == 2
+    assert {b.author() for b in store.get_blocks_by_round(1)} == {0, 1}
+    assert store.get_blocks_at_authority_round(0, 1) == [a1]
+    assert store.block_exists_at_authority_round(1, 1)
+    assert not store.block_exists_at_authority_round(2, 1)
+    assert store.all_blocks_exists_at_authority_round([0, 1], 1)
+    assert not store.all_blocks_exists_at_authority_round([0, 1, 2], 1)
+    assert store.last_seen_by_authority(0) == 2
+    assert store.last_seen_by_authority(1) == 1
+    assert store.last_own_block_ref() == dag["A2"].reference
+
+
+def test_ancestry(tmp_path, committee):
+    w, _r, core, _obs = open_store(tmp_path, committee)
+    store = core.block_store
+    writer = BlockWriter(w, store)
+    dag = Dag.draw(
+        "A1:[A0,B0,C0]; B1:[A0,B0,C0]; C1:[A0,B0,C0];"
+        "A2:[A1,B1]; B2:[B1,C1]"
+    )
+    for blk in dag.all_blocks():
+        writer.insert_block(blk)
+
+    assert store.linked(dag["A2"], dag["A1"])
+    assert store.linked(dag["A2"], dag["B1"])
+    assert not store.linked(dag["A2"], dag["C1"])
+    assert store.linked(dag["B2"], dag["C1"])
+    round1 = store.linked_to_round(dag["A2"], 1)
+    assert {b.reference for b in round1} == {dag["A1"].reference, dag["B1"].reference}
+    genesis = store.linked_to_round(dag["A2"], 0)
+    assert len(genesis) == 3
+
+
+def test_dissemination_cursors(tmp_path, committee):
+    w, _r, core, _obs = open_store(tmp_path, committee)
+    store = core.block_store
+    writer = BlockWriter(w, store)
+    dag = Dag.draw(
+        "A1:[A0,B0,C0]; B1:[A0,B0,C0]; A2:[A1,B1]; B2:[A1,B1]; A3:[A2,B2]"
+    )
+    for blk in dag.all_blocks():
+        writer.insert_block(blk)
+
+    own = store.get_own_blocks(0, 10)
+    assert [b.round() for b in own] == [1, 2, 3]
+    assert store.get_own_blocks(2, 10) == [dag["A3"]]
+    assert store.get_own_blocks(0, 2) == [dag["A1"], dag["A2"]]
+    others = store.get_others_blocks(0, 1, 10)
+    assert [b.round() for b in others] == [1, 2]
+
+
+def test_cache_unload_and_reload(tmp_path, committee):
+    w, _r, core, _obs = open_store(tmp_path, committee)
+    store = core.block_store
+    writer = BlockWriter(w, store)
+    dag = Dag.draw("A1:[A0,B0,C0]; B1:[A0,B0,C0]; A2:[A1,B1]")
+    for blk in dag.all_blocks():
+        writer.insert_block(blk)
+
+    unloaded = store.cleanup(1)
+    assert unloaded > 0
+    # Unloaded entries reload transparently from the WAL mmap.
+    assert store.get_block(dag["A1"].reference) == dag["A1"]
+    assert store.get_blocks_by_round(1)[0].to_bytes() == dag["A1"].to_bytes()
+
+
+def test_recovery_replay(tmp_path, committee):
+    path_args = (tmp_path, committee)
+    w, r, core, _obs = open_store(*path_args)
+    store = core.block_store
+    writer = BlockWriter(w, store)
+    dag = Dag.draw("A1:[A0,B0,C0]; B1:[A0,B0,C0]; C1:[A0,B0,C0]; A2:[A1,B1,C1]")
+    genesis = [b for b in dag.all_blocks() if b.round() == 0]
+    for blk in dag.all_blocks():
+        if blk.author_round() == (0, 2):
+            continue
+        writer.insert_block(blk)
+    # Own proposal A2 consumes all pending entries before it.
+    payload_pos = w.write(WAL_ENTRY_PAYLOAD, encode_payload((Share(b"tx1"),)))
+    own = OwnBlockData(next_entry=payload_pos, block=dag["A2"])
+    writer.insert_own_block(own)
+    state_pos = w.write(WAL_ENTRY_STATE, b"handler-state")
+    # Commit entry: one commit data + aggregator state.
+    cd = CommitData(dag["A1"].reference, [dag["A1"].reference], height=1)
+    cw = Writer()
+    cw.u32(1)
+    cd.encode(cw)
+    cw.bytes(b"agg-state")
+    w.write(WAL_ENTRY_COMMIT, cw.finish())
+    w.sync()
+    w.close()
+    r.close()
+
+    w2, r2 = walf(str(tmp_path / "wal"))
+    core2, obs2 = BlockStore.open(0, r2, w2, committee)
+    store2 = core2.block_store
+    assert store2.len_expensive() == len(dag)
+    assert store2.get_block(dag["A2"].reference) == dag["A2"]
+    assert store2.highest_round() == 2
+    assert store2.last_own_block_ref() == dag["A2"].reference
+    # Pending: everything before the own block was consumed; payload entry remains.
+    kinds = [type(st).__name__ for _, st in core2.pending]
+    assert kinds == ["Payload"]
+    assert core2.last_own_block is not None
+    assert core2.last_own_block.block == dag["A2"]
+    assert core2.last_own_block.next_entry == payload_pos
+    # State snapshot cleared unprocessed blocks.
+    assert core2.state == b"handler-state"
+    assert core2.unprocessed_blocks == []
+    assert core2.last_committed_leader == dag["A1"].reference
+    assert len(obs2.sub_dags) == 1
+    assert obs2.sub_dags[0].height == 1
+    assert obs2.state == b"agg-state"
+
+
+def test_recovery_without_state_snapshot_replays_blocks(tmp_path, committee):
+    w, r, core, _obs = open_store(tmp_path, committee)
+    writer = BlockWriter(w, core.block_store)
+    dag = Dag.draw("A1:[A0,B0,C0]; B1:[A0,B0,C0]")
+    for blk in dag.all_blocks():
+        writer.insert_block(blk)
+    w.sync()
+    w.close()
+    r.close()
+
+    w2, r2 = walf(str(tmp_path / "wal"))
+    core2, _ = BlockStore.open(0, r2, w2, committee)
+    # No state snapshot: all blocks must be re-run through the handler.
+    assert len(core2.unprocessed_blocks) == len(dag)
+    assert len(core2.pending) == len(dag)
+    assert all(isinstance(st, Include) for _, st in core2.pending)
