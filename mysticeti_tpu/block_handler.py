@@ -1,0 +1,285 @@
+"""Pluggable application handlers driven by the core on block arrival/proposal.
+
+Capability parity with ``mysticeti-core/src/block_handler.rs``:
+
+* ``BlockHandler`` interface {handle_blocks, handle_proposal, state, recover_state,
+  cleanup} (block_handler.rs:26-40)
+* ``BenchmarkFastPathBlockHandler`` (:53-221) — pulls generated transactions from a
+  queue (bounded by SOFT_MAX_PROPOSED_PER_BLOCK), registers own shares, tallies
+  fast-path votes via TransactionAggregator, emits VoteRange replies, records
+  certification latency metrics.
+* ``TestBlockHandler`` (:224-333) — votes immediately and emits one fresh
+  transaction per invocation; tracks proposed locators for test assertions.
+* ``SimpleBlockHandler`` (:335-395) — production-style: shares raw tx bytes pushed
+  by the application, acknowledging each via callback.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from .committee import Committee, QUORUM, TransactionAggregator
+from .log import TransactionLog
+from .serde import Reader, Writer
+from .types import (
+    AuthorityIndex,
+    BaseStatement,
+    Share,
+    StatementBlock,
+    TransactionLocator,
+)
+
+SOFT_MAX_PROPOSED_PER_BLOCK = 10 * 1000
+MAX_PROPOSED_PER_BLOCK = 10000
+
+
+class BlockHandler:
+    """Interface only; see module docstring."""
+
+    def handle_blocks(
+        self, blocks: Sequence[StatementBlock], require_response: bool
+    ) -> List[BaseStatement]:
+        raise NotImplementedError
+
+    def handle_proposal(self, block: StatementBlock) -> None:
+        raise NotImplementedError
+
+    def state(self) -> bytes:
+        raise NotImplementedError
+
+    def recover_state(self, state: bytes) -> None:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+
+class _LoggingAggregator(TransactionAggregator):
+    """TransactionAggregator whose processed-hook appends to a TransactionLog
+    (committee.rs:297-312 handler seam with the log.rs sink)."""
+
+    def __init__(self, log: Optional[TransactionLog]) -> None:
+        super().__init__(QUORUM, track_processed=log is None)
+        self._log = log
+
+    def transaction_processed(self, k: TransactionLocator) -> None:
+        if self._log is not None:
+            self._log.log(k)
+        else:
+            super().transaction_processed(k)
+
+    def duplicate_transaction(self, k, from_) -> None:
+        if self._log is None:
+            super().duplicate_transaction(k, from_)
+
+    def unknown_transaction(self, k, from_) -> None:
+        if self._log is None:
+            super().unknown_transaction(k, from_)
+
+
+class BenchmarkFastPathBlockHandler(BlockHandler):
+    """The benchmark fast path (block_handler.rs:53-221).
+
+    Transactions arrive from the generator through ``submit``; ``handle_blocks``
+    drains them (bounded) into Share statements and tallies votes; certification
+    latency is recorded against ``transaction_time`` stamps made at proposal.
+    """
+
+    def __init__(
+        self,
+        committee: Committee,
+        authority: AuthorityIndex,
+        certified_log_path: Optional[str] = None,
+        block_store=None,
+        metrics=None,
+        transaction_time: Optional[Dict[TransactionLocator, float]] = None,
+    ) -> None:
+        log = TransactionLog.start(certified_log_path) if certified_log_path else None
+        self.transaction_votes = _LoggingAggregator(log)
+        self.transaction_time: Dict[TransactionLocator, float] = (
+            transaction_time if transaction_time is not None else {}
+        )
+        self._time_lock = threading.Lock()
+        self.committee = committee
+        self.authority = authority
+        self.block_store = block_store
+        self.metrics = metrics
+        self._queue: Deque[List[bytes]] = deque()
+        self._queue_lock = threading.Lock()
+        self.pending_transactions = 0
+        self.consensus_only = "CONSENSUS_ONLY" in os.environ
+
+    # -- ingestion from the generator --
+
+    def submit(self, transactions: List[bytes]) -> None:
+        with self._queue_lock:
+            self._queue.append(transactions)
+
+    def _receive_with_limit(self) -> Optional[List[bytes]]:
+        if self.pending_transactions >= SOFT_MAX_PROPOSED_PER_BLOCK:
+            return None
+        with self._queue_lock:
+            if not self._queue:
+                return None
+            received = self._queue.popleft()
+        self.pending_transactions += len(received)
+        return received
+
+    # -- BlockHandler --
+
+    def handle_blocks(self, blocks, require_response):
+        response: List[BaseStatement] = []
+        if require_response:
+            while (received := self._receive_with_limit()) is not None:
+                response.extend(Share(tx) for tx in received)
+        now = time.time()
+        for block in blocks:
+            if self.consensus_only:
+                continue
+            processed = self.transaction_votes.process_block(
+                block, response if require_response else None, self.committee
+            )
+            if self.metrics is not None:
+                with self._time_lock:
+                    for locator in processed:
+                        created = self.transaction_time.get(locator)
+                        if created is not None:
+                            latency = max(0.0, now - created)
+                            self.metrics.latency_s.labels("owned").observe(latency)
+                            self.metrics.latency_squared_s.labels("owned").inc(
+                                latency**2
+                            )
+        if self.metrics is not None:
+            self.metrics.block_handler_pending_certificates.set(
+                len(self.transaction_votes)
+            )
+        return response
+
+    def handle_proposal(self, block: StatementBlock) -> None:
+        shared = list(block.shared_transactions())
+        self.pending_transactions -= len(shared)
+        now = time.time()
+        with self._time_lock:
+            for locator, _ in shared:
+                self.transaction_time[locator] = now
+        if not self.consensus_only:
+            from .committee import shared_ranges
+
+            for rng in shared_ranges(block):
+                self.transaction_votes.register(rng, self.authority, self.committee)
+
+    def state(self) -> bytes:
+        return self.transaction_votes.state()
+
+    def recover_state(self, state: bytes) -> None:
+        self.transaction_votes.with_state(state)
+
+    def cleanup(self) -> None:
+        cutoff = time.time() - 10.0
+        with self._time_lock:
+            self.transaction_time = {
+                k: v for k, v in self.transaction_time.items() if v >= cutoff
+            }
+
+
+class TestBlockHandler(BlockHandler):
+    """Immediately votes and generates one new transaction per call
+    (block_handler.rs:224-333)."""
+
+    def __init__(
+        self,
+        last_transaction: int,
+        committee: Committee,
+        authority: AuthorityIndex,
+        metrics=None,
+    ) -> None:
+        self.last_transaction = last_transaction
+        self.transaction_votes = TransactionAggregator(QUORUM)
+        self.committee = committee
+        self.authority = authority
+        self.proposed: List[TransactionLocator] = []
+        self.metrics = metrics
+
+    def is_certified(self, locator: TransactionLocator) -> bool:
+        return self.transaction_votes.is_processed(locator)
+
+    @staticmethod
+    def make_transaction(i: int) -> bytes:
+        return i.to_bytes(8, "little")
+
+    def handle_blocks(self, blocks, require_response):
+        response: List[BaseStatement] = []
+        if require_response:
+            for block in blocks:
+                if block.author() == self.authority:
+                    # Own blocks can resurface during recovery; keep the
+                    # transaction counter monotone (block_handler.rs:268-281).
+                    for st in block.statements:
+                        if isinstance(st, Share):
+                            self.last_transaction += 1
+            self.last_transaction += 1
+            response.append(Share(self.make_transaction(self.last_transaction)))
+        for block in blocks:
+            self.transaction_votes.process_block(
+                block, response if require_response else None, self.committee
+            )
+        return response
+
+    def handle_proposal(self, block: StatementBlock) -> None:
+        from .committee import shared_ranges
+
+        for locator, _ in block.shared_transactions():
+            self.proposed.append(locator)
+        for rng in shared_ranges(block):
+            self.transaction_votes.register(rng, self.authority, self.committee)
+
+    def state(self) -> bytes:
+        w = Writer()
+        w.bytes(self.transaction_votes.state())
+        w.u64(self.last_transaction)
+        return w.finish()
+
+    def recover_state(self, state: bytes) -> None:
+        r = Reader(state)
+        self.transaction_votes.with_state(r.bytes())
+        self.last_transaction = r.u64()
+        r.expect_done()
+
+
+class SimpleBlockHandler(BlockHandler):
+    """Production-style: share raw transaction bytes pushed by the application;
+    acknowledge each once drained into a proposal (block_handler.rs:335-395)."""
+
+    def __init__(self) -> None:
+        self._queue: Deque[Tuple[bytes, Optional[Callable[[], None]]]] = deque()
+        self._lock = threading.Lock()
+
+    def submit(self, tx_bytes: bytes, done: Optional[Callable[[], None]] = None) -> None:
+        with self._lock:
+            self._queue.append((tx_bytes, done))
+
+    def handle_blocks(self, blocks, require_response):
+        if not require_response:
+            return []
+        response: List[BaseStatement] = []
+        while len(response) < MAX_PROPOSED_PER_BLOCK:
+            with self._lock:
+                if not self._queue:
+                    break
+                tx_bytes, done = self._queue.popleft()
+            response.append(Share(tx_bytes))
+            if done is not None:
+                done()
+        return response
+
+    def handle_proposal(self, block: StatementBlock) -> None:
+        pass
+
+    def state(self) -> bytes:
+        return b""
+
+    def recover_state(self, state: bytes) -> None:
+        pass

@@ -1,0 +1,122 @@
+"""Node configuration: protocol parameters, synchronizer tuning, storage layout.
+
+Capability parity with ``mysticeti-core/src/config.rs``:
+
+* ``Parameters`` (config.rs:38-117) — identifiers (hostname/ports per authority),
+  wave length, leader timeout, rounds per epoch, shutdown grace, leaders per
+  round, pipelining, store retention, cleanup switch, synchronizer parameters,
+  network latency breaker threshold.
+* ``SynchronizerParameters`` (config.rs:76-100).
+* YAML print/load (config.rs:16-29).
+* ``PrivateConfig`` / ``StorageDir`` (config.rs:197-251) — per-authority key +
+  storage paths: wal, certified tx log, committed tx log.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, asdict
+from typing import List, Optional, Tuple
+
+import yaml
+
+ROUNDS_IN_EPOCH_MAX = 2**63  # effectively "never close the epoch"
+
+DEFAULT_PORT_BASE = 1500
+DEFAULT_METRICS_PORT_OFFSET = 1000
+
+
+@dataclass
+class Identifier:
+    """Network identity of one authority (config.rs:31-36)."""
+
+    hostname: str
+    port: int
+    metrics_port: int
+
+
+@dataclass
+class SynchronizerParameters:
+    """Dissemination/fetch tuning (config.rs:76-100)."""
+
+    absolute_maximum_helpers: int = 32
+    maximum_helpers_per_authority: int = 2
+    batch_size: int = 100
+    sample_precision_s: float = 0.25
+    stream_interval_s: float = 1.0
+    new_stream_threshold: int = 10
+
+
+@dataclass
+class Parameters:
+    identifiers: List[Identifier] = field(default_factory=list)
+    wave_length: int = 3
+    leader_timeout_s: float = 2.0
+    rounds_in_epoch: int = ROUNDS_IN_EPOCH_MAX
+    shutdown_grace_period_s: float = 2.0
+    number_of_leaders: int = 1
+    enable_pipelining: bool = True
+    enable_cleanup: bool = True
+    store_retain_rounds: int = 500
+    synchronizer: SynchronizerParameters = field(default_factory=SynchronizerParameters)
+    network_connection_max_latency_s: float = 5.0
+
+    @classmethod
+    def new_for_benchmarks(cls, ips: List[str]) -> "Parameters":
+        """Benchmark defaults mirroring Parameters::new_for_benchmarks (config.rs:57-72)."""
+        identifiers = [
+            Identifier(
+                hostname=ip,
+                port=DEFAULT_PORT_BASE + i,
+                metrics_port=DEFAULT_PORT_BASE + DEFAULT_METRICS_PORT_OFFSET + i,
+            )
+            for i, ip in enumerate(ips)
+        ]
+        return cls(identifiers=identifiers)
+
+    def address(self, authority: int) -> Tuple[str, int]:
+        ident = self.identifiers[authority]
+        return ident.hostname, ident.port
+
+    def metrics_address(self, authority: int) -> Tuple[str, int]:
+        ident = self.identifiers[authority]
+        return ident.hostname, ident.metrics_port
+
+    def all_network_addresses(self) -> List[Tuple[str, int]]:
+        return [(i.hostname, i.port) for i in self.identifiers]
+
+    # -- YAML round-trip (config.rs:16-29) --
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(asdict(self), f, sort_keys=False)
+
+    @classmethod
+    def load(cls, path: str) -> "Parameters":
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        sync = SynchronizerParameters(**raw.pop("synchronizer", {}))
+        identifiers = [Identifier(**i) for i in raw.pop("identifiers", [])]
+        return cls(identifiers=identifiers, synchronizer=sync, **raw)
+
+
+@dataclass
+class PrivateConfig:
+    """Per-authority private material + storage paths (config.rs:197-251)."""
+
+    authority: int
+    storage_path: str
+    keypair_seed: bytes = b""
+
+    @classmethod
+    def new_in_dir(cls, authority: int, dir_: str) -> "PrivateConfig":
+        os.makedirs(dir_, exist_ok=True)
+        return cls(authority=authority, storage_path=dir_)
+
+    def wal(self) -> str:
+        return os.path.join(self.storage_path, "wal")
+
+    def certified_transactions_log(self) -> str:
+        return os.path.join(self.storage_path, "certified.txt")
+
+    def committed_transactions_log(self) -> str:
+        return os.path.join(self.storage_path, "committed.txt")

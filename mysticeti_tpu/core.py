@@ -1,0 +1,318 @@
+"""The single-owner consensus state machine: ingest blocks, propose, commit, persist.
+
+Capability parity with ``mysticeti-core/src/core.rs``:
+
+* ``Core.open`` — genesis bootstrap or WAL recovery (core.rs:69-161)
+* ``add_blocks`` — BlockManager gate, threshold clock, pending queue, handler run
+  (core.rs:171-207)
+* ``run_block_handler`` — handler statements become a persisted Payload pending
+  entry (core.rs:209-225)
+* ``try_new_block`` — drain pending up to the clock round, include-compression,
+  sign, persist own block with the next-entry cursor, optional fsync
+  (core.rs:227-328)
+* ``try_commit`` -> UniversalCommitter + epoch-change trigger (core.rs:368-385)
+* ``ready_new_block`` — leader-aware proposal gating (core.rs:401-450)
+* ``handle_committed_subdag`` — epoch observation + state/commit WAL records
+  (core.rs:452-490)
+* ``cleanup`` (core.rs:387-395)
+
+Single-writer discipline: exactly one owner task/thread may call the mutating
+methods; everything else reads through the BlockStore (core_thread/spawned.rs).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, List, Optional, Sequence, Set, Tuple
+
+from .block_manager import BlockManager
+from .block_store import (
+    BlockStore,
+    BlockWriter,
+    CommitData,
+    OwnBlockData,
+    WAL_ENTRY_COMMIT,
+    WAL_ENTRY_PAYLOAD,
+    WAL_ENTRY_STATE,
+)
+from .committee import Committee
+from .config import Parameters
+from .consensus import AuthorityRound, LeaderStatus
+from .consensus.linearizer import CommittedSubDag
+from .consensus.universal_committer import UniversalCommitter, UniversalCommitterBuilder
+from .crypto import Signer
+from .epoch_close import EpochManager
+from .serde import Writer
+from .state import CoreRecoveredState, Include, MetaStatement, Payload, encode_payload
+from .threshold_clock import ThresholdClockAggregator
+from .types import (
+    AuthorityIndex,
+    AuthoritySet,
+    BlockReference,
+    RoundNumber,
+    StatementBlock,
+)
+from .wal import POSITION_MAX, WalPosition, WalSyncer, WalWriter
+
+
+class CoreOptions:
+    __slots__ = ("fsync",)
+
+    def __init__(self, fsync: bool = False) -> None:
+        self.fsync = fsync
+
+    @classmethod
+    def test(cls) -> "CoreOptions":
+        return cls(fsync=False)
+
+    @classmethod
+    def production(cls) -> "CoreOptions":
+        return cls(fsync=True)
+
+
+class Core:
+    def __init__(
+        self,
+        block_handler,
+        authority: AuthorityIndex,
+        committee: Committee,
+        parameters: Parameters,
+        recovered: CoreRecoveredState,
+        wal_writer: WalWriter,
+        options: Optional[CoreOptions] = None,
+        signer: Optional[Signer] = None,
+        metrics=None,
+    ) -> None:
+        """Equivalent of ``Core::open`` (core.rs:69-161)."""
+        block_store: BlockStore = recovered.block_store
+        pending = recovered.pending
+        threshold_clock = ThresholdClockAggregator(0, metrics)
+        writer = BlockWriter(wal_writer, block_store)
+
+        if recovered.last_own_block is not None:
+            # Recovery: replay pending includes into the clock (core.rs:89-95).
+            for _, meta in pending:
+                if isinstance(meta, Include):
+                    threshold_clock.add_block(meta.reference, committee)
+            last_own_block = recovered.last_own_block
+        else:
+            assert not pending
+            own_genesis, other_genesis = committee.genesis_blocks(authority)
+            assert own_genesis.author() == authority
+            for block in other_genesis:
+                threshold_clock.add_block(block.reference, committee)
+                position = writer.insert_block(block)
+                pending.append((position, Include(block.reference)))
+            threshold_clock.add_block(own_genesis.reference, committee)
+            last_own_block = OwnBlockData(next_entry=POSITION_MAX, block=own_genesis)
+            writer.insert_own_block(last_own_block)
+
+        if recovered.state is not None:
+            block_handler.recover_state(recovered.state)
+
+        self.block_manager = BlockManager(block_store, len(committee), metrics)
+        self.pending: Deque[Tuple[WalPosition, MetaStatement]] = pending
+        self.last_own_block: OwnBlockData = last_own_block
+        self.block_handler = block_handler
+        self.authority = authority
+        self.threshold_clock = threshold_clock
+        self.committee = committee
+        last = recovered.last_committed_leader
+        self.last_decided_leader = (
+            AuthorityRound(last.authority, last.round) if last else AuthorityRound(0, 0)
+        )
+        self.wal_writer = wal_writer
+        self.block_store = block_store
+        self.metrics = metrics
+        self.options = options or CoreOptions.test()
+        self.signer = signer
+        self.epoch_manager = EpochManager()
+        self.rounds_in_epoch = parameters.rounds_in_epoch
+        self.store_retain_rounds = parameters.store_retain_rounds
+        self.committer: UniversalCommitter = (
+            UniversalCommitterBuilder(committee, block_store, metrics)
+            .with_wave_length(parameters.wave_length)
+            .with_number_of_leaders(parameters.number_of_leaders)
+            .with_pipeline(parameters.enable_pipelining)
+            .build()
+        )
+
+        if recovered.unprocessed_blocks:
+            # Blocks after the last state snapshot re-run through the handler
+            # (core.rs:152-158).
+            self.run_block_handler(recovered.unprocessed_blocks)
+
+    # -- ingestion (core.rs:171-207) --
+
+    def add_blocks(self, blocks: Sequence[StatementBlock]) -> List[BlockReference]:
+        """Returns first-seen missing references needed to process the input."""
+        writer = BlockWriter(self.wal_writer, self.block_store)
+        processed, missing_references = self.block_manager.add_blocks(blocks, writer)
+        result = []
+        for position, block in sorted(processed, key=lambda pb: pb[1].round()):
+            self.threshold_clock.add_block(block.reference, self.committee)
+            self.pending.append((position, Include(block.reference)))
+            result.append(block)
+        self.run_block_handler(result)
+        return list(missing_references)
+
+    def run_block_handler(self, processed: Sequence[StatementBlock]) -> None:
+        statements = self.block_handler.handle_blocks(
+            processed, require_response=not self.epoch_changing()
+        )
+        position = self.wal_writer.write(WAL_ENTRY_PAYLOAD, encode_payload(statements))
+        self.pending.append((position, Payload(tuple(statements))))
+
+    # -- proposal (core.rs:227-328) --
+
+    def try_new_block(self) -> Optional[StatementBlock]:
+        clock_round = self.threshold_clock.get_round()
+        if clock_round <= self.last_proposed():
+            return None
+
+        # Take pending entries up to (not including) the first include at or past
+        # the clock round (core.rs:240-251).
+        first_include_index = len(self.pending)
+        for i, (_, meta) in enumerate(self.pending):
+            if isinstance(meta, Include) and meta.reference.round >= clock_round:
+                first_include_index = i
+                break
+        taken = [self.pending.popleft() for _ in range(first_include_index)]
+
+        # Include-compression: skip references already transitively covered by
+        # the includes taken into this block (core.rs:253-278).
+        references_in_block: Set[BlockReference] = set()
+        references_in_block.update(self.last_own_block.block.includes)
+        for _, meta in taken:
+            if isinstance(meta, Include):
+                block = self.block_store.get_block(meta.reference)
+                if block is not None:
+                    references_in_block.update(block.includes)
+
+        includes: List[BlockReference] = [self.last_own_block.block.reference]
+        statements: List = []
+        for _, meta in taken:
+            if isinstance(meta, Include):
+                if meta.reference not in references_in_block:
+                    includes.append(meta.reference)
+            else:
+                if not self.epoch_changing():
+                    statements.extend(meta.statements)
+
+        assert includes
+        block = StatementBlock.build(
+            self.authority,
+            clock_round,
+            includes,
+            statements,
+            meta_creation_time_ns=time.time_ns(),
+            epoch_marker=1 if self.epoch_changing() else 0,
+            epoch=self.committee.epoch,
+            signer=self.signer,
+        )
+        assert block.includes[0].authority == self.authority
+
+        self.threshold_clock.add_block(block.reference, self.committee)
+        self.block_handler.handle_proposal(block)
+        next_entry = self.pending[0][0] if self.pending else POSITION_MAX
+        self.last_own_block = OwnBlockData(next_entry=next_entry, block=block)
+        BlockWriter(self.wal_writer, self.block_store).insert_own_block(
+            self.last_own_block
+        )
+        if self.options.fsync:
+            self.wal_writer.sync()
+        return block
+
+    # -- commit (core.rs:368-385) --
+
+    def try_commit(self) -> List[StatementBlock]:
+        sequence = self.committer.try_commit(self.last_decided_leader)
+        if sequence:
+            self.last_decided_leader = sequence[-1].into_decided_author_round()
+        if self.last_decided_leader.round > self.rounds_in_epoch:
+            self.epoch_manager.epoch_change_begun()
+        return [s.block for s in sequence if s.kind == LeaderStatus.COMMIT]
+
+    def ready_new_block(self, period: int, connected_authorities: AuthoritySet) -> bool:
+        """Leader-aware proposal gating (core.rs:401-450): propose when the previous
+        round's (connected) leaders have been received, or there are none."""
+        quorum_round = self.threshold_clock.get_round()
+        if quorum_round <= max(self.last_decided_leader.round, period - 1):
+            return False
+        leader_round = quorum_round - 1
+        leaders = self.committer.get_leaders(leader_round)
+        if not leaders:
+            return True
+        connected_leaders = [
+            l for l in leaders if connected_authorities.contains(l)
+        ]
+        if not connected_leaders:
+            return True
+        return self.block_store.all_blocks_exists_at_authority_round(
+            connected_leaders, leader_round
+        )
+
+    # -- commit persistence (core.rs:452-490) --
+
+    def handle_committed_subdag(
+        self, committed: List[CommittedSubDag], state: bytes
+    ) -> List[CommitData]:
+        commit_data = []
+        for commit in committed:
+            for block in commit.blocks:
+                self.epoch_manager.observe_committed_block(block, self.committee)
+            commit_data.append(
+                CommitData(
+                    leader=commit.anchor,
+                    sub_dag=[b.reference for b in commit.blocks],
+                    height=commit.height,
+                )
+            )
+        self.write_state()
+        self.write_commits(commit_data, state)
+        return commit_data
+
+    def write_state(self) -> None:
+        self.wal_writer.write(WAL_ENTRY_STATE, self.block_handler.state())
+
+    def write_commits(self, commits: List[CommitData], state: bytes) -> None:
+        w = Writer()
+        w.u32(len(commits))
+        for c in commits:
+            c.encode(w)
+        w.bytes(state)
+        self.wal_writer.write(WAL_ENTRY_COMMIT, w.finish())
+
+    # -- maintenance --
+
+    def cleanup(self) -> None:
+        self.block_store.cleanup(
+            max(0, self.last_decided_leader.round - self.store_retain_rounds)
+        )
+        self.block_handler.cleanup()
+
+    def wal_syncer(self) -> WalSyncer:
+        return self.wal_writer.syncer()
+
+    # -- accessors --
+
+    def leaders(self, round_: RoundNumber) -> List[AuthorityIndex]:
+        return self.committer.get_leaders(round_)
+
+    def current_round(self) -> RoundNumber:
+        return self.threshold_clock.get_round()
+
+    def last_proposed(self) -> RoundNumber:
+        return self.last_own_block.block.round()
+
+    def last_own_block_value(self) -> StatementBlock:
+        return self.last_own_block.block
+
+    def epoch_closed(self) -> bool:
+        return self.epoch_manager.closed()
+
+    def epoch_changing(self) -> bool:
+        return self.epoch_manager.changing()
+
+    def epoch_closing_time(self) -> int:
+        return self.epoch_manager.closing_time()

@@ -1,0 +1,61 @@
+"""Append-only text log of processed transaction locators.
+
+Capability parity with ``mysticeti-core/src/log.rs``: a ``TransactionLog`` opened
+for write that records each certified/committed locator on its own line
+(log.rs:10-33).  The reference offloads writes to a blocking tokio pool; here a
+buffered writer + explicit flush keeps the consensus owner task non-blocking in
+practice (page-cache writes), and the bench harness reads the file back for the
+safety cross-checks.
+"""
+from __future__ import annotations
+
+import io
+from typing import Iterable, List
+
+from .types import TransactionLocator
+
+
+class TransactionLog:
+    """File-backed sink usable as a TransactionAggregator handler hook."""
+
+    __slots__ = ("_file",)
+
+    def __init__(self, path: str) -> None:
+        self._file = open(path, "a", buffering=1 << 16)
+
+    @classmethod
+    def start(cls, path: str) -> "TransactionLog":
+        return cls(path)
+
+    def log(self, locator: TransactionLocator) -> None:
+        self._file.write(
+            f"{locator.block.authority},{locator.block.round},"
+            f"{locator.block.digest.hex()},{locator.offset}\n"
+        )
+
+    def log_all(self, locators: Iterable[TransactionLocator]) -> None:
+        for loc in locators:
+            self.log(loc)
+
+    def flush(self) -> None:
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+    @staticmethod
+    def read_locators(path: str) -> List[TransactionLocator]:
+        from .types import BlockReference
+
+        out = []
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                a, r, d, off = line.strip().split(",")
+                out.append(
+                    TransactionLocator(
+                        BlockReference(int(a), int(r), bytes.fromhex(d)), int(off)
+                    )
+                )
+        return out

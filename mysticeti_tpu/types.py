@@ -430,6 +430,12 @@ class StatementBlock:
     def epoch_changed(self) -> bool:
         return self.epoch_marker != EPOCH_OPEN
 
+    def shared_transactions(self) -> Iterator[Tuple["TransactionLocator", bytes]]:
+        """(locator, payload) for every Share statement (types.rs shared_transactions)."""
+        for offset, st in enumerate(self.statements):
+            if isinstance(st, Share):
+                yield TransactionLocator(self.reference, offset), st.transaction
+
     # -- verification (types.rs:315-376) --
 
     def verify_structure(self, committee) -> None:

@@ -1,0 +1,64 @@
+"""Shared test fixtures: in-process cores wired to temp WALs (test_util.rs parity)."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from mysticeti_tpu.block_handler import TestBlockHandler
+from mysticeti_tpu.block_store import BlockStore, BlockWriter
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.config import Parameters
+from mysticeti_tpu.core import Core, CoreOptions
+from mysticeti_tpu.wal import walf
+
+
+def committee_and_cores(
+    n: int, tmp_dir: str, parameters: Optional[Parameters] = None
+) -> Tuple[Committee, List[Core]]:
+    """N in-process cores with TestBlockHandlers over per-authority WALs
+    (test_util.rs committee_and_cores)."""
+    committee = Committee.new_test([1] * n)
+    signers = Committee.benchmark_signers(n)
+    parameters = parameters or Parameters()
+    cores = []
+    for authority in range(n):
+        core = open_core(committee, authority, tmp_dir, signers[authority], parameters)
+        cores.append(core)
+    return committee, cores
+
+
+def open_core(committee, authority, tmp_dir, signer, parameters=None):
+    wal_path = os.path.join(tmp_dir, f"wal-{authority}")
+    wal_writer, wal_reader = walf(wal_path)
+    recovered, _observer = BlockStore.open(authority, wal_reader, wal_writer, committee)
+    handler = TestBlockHandler(
+        last_transaction=authority * 1_000_000, committee=committee, authority=authority
+    )
+    return Core(
+        block_handler=handler,
+        authority=authority,
+        committee=committee,
+        parameters=parameters or Parameters(),
+        recovered=recovered,
+        wal_writer=wal_writer,
+        options=CoreOptions.test(),
+        signer=signer,
+    )
+
+
+class DagBlockWriter:
+    """Standalone store + writer for committer tests (test_util.rs:377-432)."""
+
+    def __init__(self, committee: Committee, tmp_dir: str, name: str = "tw-wal"):
+        wal_path = os.path.join(tmp_dir, name)
+        self.wal_writer, self.wal_reader = walf(wal_path)
+        recovered, _ = BlockStore.open(0, self.wal_reader, self.wal_writer, committee)
+        self.block_store = recovered.block_store
+        self._writer = BlockWriter(self.wal_writer, self.block_store)
+
+    def add_block(self, block):
+        return self._writer.insert_block(block)
+
+    def add_blocks(self, blocks):
+        for b in blocks:
+            self.add_block(b)

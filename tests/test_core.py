@@ -1,0 +1,155 @@
+"""Core engine tests — parity with core.rs:562-775 (simple exchange, randomized
+exchange over seeds, WAL recovery)."""
+import random
+
+import pytest
+
+from mysticeti_tpu.threshold_clock import threshold_clock_valid_non_genesis
+
+from helpers import committee_and_cores, open_core
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.config import Parameters
+
+
+def test_core_simple_exchange(tmp_path):
+    _committee, cores = committee_and_cores(4, str(tmp_path))
+
+    proposed_transactions = []
+    blocks = []
+    for core in cores:
+        core.run_block_handler([])
+        block = core.try_new_block()
+        assert block is not None, "must propose after genesis"
+        assert block.round() == 1
+        proposed_transactions.extend(core.block_handler.proposed)
+        core.block_handler.proposed.clear()
+        blocks.append(block)
+    assert len(proposed_transactions) == 4
+
+    more_blocks = blocks[1:]
+    first = blocks[:1]
+
+    blocks_r2 = []
+    for core in cores:
+        core.add_blocks(first)
+        assert core.try_new_block() is None  # no quorum yet
+        core.add_blocks(more_blocks)
+        block = core.try_new_block()
+        assert block is not None, "must propose after full round"
+        assert block.round() == 2
+        blocks_r2.append(block)
+
+    for core in cores:
+        core.add_blocks(blocks_r2)
+        block = core.try_new_block()
+        assert block is not None
+        assert block.round() == 3
+        for txid in proposed_transactions:
+            assert core.block_handler.is_certified(txid), (
+                f"tx {txid} not certified by {core.authority}"
+            )
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_randomized_simple_exchange(tmp_path, seed):
+    rng = random.Random(seed)
+    committee, cores = committee_and_cores(4, str(tmp_path))
+
+    proposed_transactions = []
+    pending = [[] for _ in range(4)]
+
+    def push_all(except_authority, block):
+        for i, q in enumerate(pending):
+            if i != except_authority:
+                q.append(block)
+
+    for core in cores:
+        core.run_block_handler([])
+        block = core.try_new_block()
+        assert block is not None and block.round() == 1
+        assert threshold_clock_valid_non_genesis(block, committee)
+        proposed_transactions.extend(core.block_handler.proposed)
+        core.block_handler.proposed.clear()
+        push_all(core.authority, block)
+
+    for i in range(1000):
+        authority = rng.randrange(4)
+        core = cores[authority]
+        this_pending = pending[authority]
+        count = rng.randint(1, 3)
+        blocks = []
+        for _ in range(count):
+            if not this_pending:
+                break
+            blocks.append(this_pending.pop(rng.randrange(len(this_pending))))
+        if not blocks:
+            continue
+        core.add_blocks(blocks)
+        block = core.try_new_block()
+        if block is None:
+            continue
+        assert threshold_clock_valid_non_genesis(block, committee)
+        push_all(core.authority, block)
+        if i < 20:
+            proposed_transactions.extend(core.block_handler.proposed)
+            core.block_handler.proposed.clear()
+        else:
+            assert proposed_transactions
+            if all(
+                c.block_handler.is_certified(tx)
+                for tx in proposed_transactions
+                for c in cores
+            ):
+                return  # all certified everywhere
+    pytest.fail(f"seed {seed}: not all transactions certified")
+
+
+def test_core_recovery(tmp_path):
+    tmp = str(tmp_path)
+    _committee, cores = committee_and_cores(4, tmp)
+
+    proposed_transactions = []
+    blocks = []
+    for core in cores:
+        core.run_block_handler([])
+        block = core.try_new_block()
+        assert block is not None and block.round() == 1
+        proposed_transactions.extend(core.block_handler.proposed)
+        blocks.append(block)
+    assert len(proposed_transactions) == 4
+    for core in cores:
+        core.write_state()
+        core.wal_writer.close()
+    del cores
+
+    # Reopen all cores from their WALs.
+    committee = Committee.new_test([1] * 4)
+    signers = Committee.benchmark_signers(4)
+    cores = [open_core(committee, a, tmp, signers[a]) for a in range(4)]
+
+    first, more_blocks = blocks[:2], blocks[2:]
+    blocks_r2 = []
+    for core in cores:
+        core.add_blocks(first)
+        assert core.try_new_block() is None
+        core.add_blocks(more_blocks)
+        block = core.try_new_block()
+        assert block is not None, "must propose after full round"
+        assert block.round() == 2
+        blocks_r2.append(block)
+
+    # No write_state here: recovery must replay unprocessed blocks instead.
+    for core in cores:
+        core.wal_writer.close()
+    del cores
+
+    cores = [open_core(committee, a, tmp, signers[a]) for a in range(4)]
+    for core in cores:
+        core.add_blocks(blocks_r2)
+        block = core.try_new_block()
+        assert block is not None
+        assert block.round() == 3
+        for txid in proposed_transactions:
+            assert core.block_handler.is_certified(txid), (
+                f"tx {txid} not certified by {core.authority} after recovery"
+            )
