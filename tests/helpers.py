@@ -46,6 +46,47 @@ def open_core(committee, authority, tmp_dir, signer, parameters=None):
     )
 
 
+def build_dag(committee, block_writer, start, stop):
+    """Fully-connected DAG from ``start`` refs (or genesis) to round ``stop``
+    (test_util.rs:436-485).  Returns the references of the last layer."""
+    from mysticeti_tpu.types import StatementBlock
+
+    if start is not None:
+        assert start
+        assert len({r.round for r in start}) == 1
+        includes = list(start)
+    else:
+        genesis = [
+            StatementBlock.new_genesis(a, committee.epoch)
+            for a in committee.authority_indexes()
+        ]
+        block_writer.add_blocks(genesis)
+        includes = [b.reference for b in genesis]
+
+    starting_round = includes[0].round + 1
+    for round_ in range(starting_round, stop + 1):
+        blocks = [
+            StatementBlock.build(a, round_, includes, (), epoch=committee.epoch)
+            for a in committee.authority_indexes()
+        ]
+        block_writer.add_blocks(blocks)
+        includes = [b.reference for b in blocks]
+    return includes
+
+
+def build_dag_layer(connections, block_writer):
+    """One explicit layer: [(authority, parent refs)] (test_util.rs:487-511)."""
+    from mysticeti_tpu.types import StatementBlock
+
+    references = []
+    for authority, parents in connections:
+        round_ = parents[0].round + 1
+        block = StatementBlock.build(authority, round_, parents, ())
+        references.append(block.reference)
+        block_writer.add_block(block)
+    return references
+
+
 class DagBlockWriter:
     """Standalone store + writer for committer tests (test_util.rs:377-432)."""
 

@@ -1,0 +1,227 @@
+"""Commit-rule gold suite, single leader, no pipeline — the 9 canonical cases of
+``consensus/tests/base_committer_tests.rs``."""
+import pytest
+
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.consensus import AuthorityRound, DEFAULT_WAVE_LENGTH, LeaderStatus
+from mysticeti_tpu.consensus.universal_committer import UniversalCommitterBuilder
+
+from helpers import DagBlockWriter, build_dag, build_dag_layer
+
+WAVE = DEFAULT_WAVE_LENGTH
+
+
+@pytest.fixture
+def committee():
+    return Committee.new_test([1, 1, 1, 1])
+
+
+def make_committer(committee, writer, **kwargs):
+    b = UniversalCommitterBuilder(committee, writer.block_store)
+    b.with_wave_length(kwargs.get("wave_length", WAVE))
+    b.with_number_of_leaders(kwargs.get("number_of_leaders", 1))
+    b.with_pipeline(kwargs.get("pipeline", False))
+    return b.build()
+
+
+def test_direct_commit(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, 5)
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 1
+    assert sequence[0].kind == LeaderStatus.COMMIT
+    assert sequence[0].block.author() == committee.elect_leader(WAVE, 0)
+
+
+def test_idempotence(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    all_refs = build_dag(committee, writer, None, 5)
+    committer = make_committer(committee, writer)
+    committed = committer.try_commit(AuthorityRound(0, 0))
+    assert len(committed) == 1
+    build_dag(committee, writer, all_refs, 8)
+    last = committed[-1]
+    sequence = committer.try_commit(AuthorityRound(last.authority, last.round))
+    assert len(sequence) == 1
+    assert sequence[0].round == 6
+
+
+def test_multiple_direct_commit(committee, tmp_path):
+    last_committed = AuthorityRound(0, 0)
+    for n in range(1, 11):
+        enough_blocks = WAVE * (n + 1) - 1
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{n}")
+        build_dag(committee, writer, None, enough_blocks)
+        committer = make_committer(committee, writer)
+        sequence = committer.try_commit(last_committed)
+        assert len(sequence) == 1
+        leader_round = n * WAVE
+        assert sequence[0].kind == LeaderStatus.COMMIT
+        assert sequence[0].block.author() == committee.elect_leader(leader_round, 0)
+        last = sequence[-1]
+        last_committed = AuthorityRound(last.authority, last.round)
+
+
+def test_direct_commit_late_call(committee, tmp_path):
+    n = 10
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, WAVE * (n + 1) - 1)
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == n
+    for i, status in enumerate(sequence):
+        leader_round = (i + 1) * WAVE
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == committee.elect_leader(leader_round, 0)
+
+
+def test_no_genesis_commit(committee, tmp_path):
+    first_commit_round = 2 * WAVE - 1
+    for r in range(first_commit_round):
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{r}")
+        build_dag(committee, writer, None, r)
+        committer = make_committer(committee, writer)
+        assert committer.try_commit(AuthorityRound(0, 0)) == []
+
+
+def test_no_leader(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    # Wave 0 completes, then build to the decision round of leader 1 without the leader.
+    references = build_dag(committee, writer, None, WAVE - 1)
+    leader_round_1 = WAVE
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    connections = [
+        (a, references) for a in committee.authority_indexes() if a != leader_1
+    ]
+    references = build_dag_layer(connections, writer)
+    build_dag(committee, writer, references, 2 * WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 1
+    assert sequence[0].kind == LeaderStatus.SKIP
+    assert sequence[0].authority == leader_1
+    assert sequence[0].round == leader_round_1
+
+
+def test_direct_skip(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    leader_round_1 = WAVE
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != committee.elect_leader(leader_round_1, 0)
+    ]
+    build_dag(committee, writer, references_without_leader_1, 2 * WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 1
+    assert sequence[0].kind == LeaderStatus.SKIP
+    assert sequence[0].authority == committee.elect_leader(leader_round_1, 0)
+    assert sequence[0].round == leader_round_1
+
+
+def test_indirect_commit(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    leader_round_1 = WAVE
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+    quorum = committee.quorum_threshold()
+    validity = committee.validity_threshold()
+    authorities = list(committee.authority_indexes())
+
+    # Only 2f+1 validators vote for leader 1.
+    refs_with_votes = build_dag_layer(
+        [(a, references_1) for a in authorities[:quorum]], writer
+    )
+    refs_without_votes = build_dag_layer(
+        [(a, references_without_leader_1) for a in authorities[quorum:]], writer
+    )
+
+    # Only f+1 validators certify leader 1.
+    references_3 = []
+    references_3.extend(
+        build_dag_layer(
+            [(a, refs_with_votes) for a in authorities[:validity]], writer
+        )
+    )
+    mixed = (refs_without_votes + refs_with_votes)[:quorum]
+    references_3.extend(
+        build_dag_layer(
+            [(a, mixed) for a in authorities[validity:]], writer
+        )
+    )
+
+    # Build to the decision round of the 2nd leader; it indirect-commits leader 1.
+    build_dag(committee, writer, references_3, 3 * WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 2
+    assert sequence[0].kind == LeaderStatus.COMMIT
+    assert sequence[0].block.author() == leader_1
+
+
+def test_indirect_skip(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    leader_round_2 = 2 * WAVE
+    references_2 = build_dag(committee, writer, None, leader_round_2)
+    leader_2 = committee.elect_leader(leader_round_2, 0)
+    references_without_leader_2 = [
+        r for r in references_2 if r.authority != leader_2
+    ]
+    validity = committee.validity_threshold()
+    authorities = list(committee.authority_indexes())
+
+    # Only f+1 validators connect to leader 2.
+    references = []
+    references.extend(
+        build_dag_layer(
+            [(a, references_2) for a in authorities[:validity]], writer
+        )
+    )
+    references.extend(
+        build_dag_layer(
+            [(a, references_without_leader_2) for a in authorities[validity:]], writer
+        )
+    )
+    build_dag(committee, writer, references, 4 * WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 3
+
+    leader_1 = committee.elect_leader(WAVE, 0)
+    assert sequence[0].kind == LeaderStatus.COMMIT
+    assert sequence[0].block.author() == leader_1
+    assert sequence[1].kind == LeaderStatus.SKIP
+    assert sequence[1].authority == leader_2
+    assert sequence[1].round == leader_round_2
+    leader_3 = committee.elect_leader(3 * WAVE, 0)
+    assert sequence[2].kind == LeaderStatus.COMMIT
+    assert sequence[2].block.author() == leader_3
+
+
+def test_undecided(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    leader_round_1 = WAVE
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != committee.elect_leader(leader_round_1, 0)
+    ]
+    authorities = list(committee.authority_indexes())
+    quorum = committee.quorum_threshold()
+
+    # Exactly one vote for leader 1; 2f more blocks that miss it.
+    connections = [(authorities[0], references_1)] + [
+        (a, references_without_leader_1) for a in authorities[1:quorum]
+    ]
+    references = build_dag_layer(connections, writer)
+    build_dag(committee, writer, references, 2 * WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    assert committer.try_commit(AuthorityRound(0, 0)) == []

@@ -1,0 +1,158 @@
+"""Multi-leader commit-rule gold suite — ``consensus/tests/multi_committer_tests.rs``."""
+import pytest
+
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.consensus import AuthorityRound, DEFAULT_WAVE_LENGTH, LeaderStatus
+from mysticeti_tpu.consensus.universal_committer import UniversalCommitterBuilder
+
+from helpers import DagBlockWriter, build_dag, build_dag_layer
+
+WAVE = DEFAULT_WAVE_LENGTH
+
+
+@pytest.fixture
+def committee():
+    return Committee.new_test([1, 1, 1, 1])
+
+
+def make_committer(committee, writer, number_of_leaders):
+    return (
+        UniversalCommitterBuilder(committee, writer.block_store)
+        .with_wave_length(WAVE)
+        .with_number_of_leaders(number_of_leaders)
+        .build()
+    )
+
+
+def test_direct_commit(committee, tmp_path):
+    for number_of_leaders in range(1, len(committee)):
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{number_of_leaders}")
+        build_dag(committee, writer, None, 5)
+        committer = make_committer(committee, writer, number_of_leaders)
+        sequence = committer.try_commit(AuthorityRound(0, 0))
+        assert len(sequence) == number_of_leaders
+        for leader_offset, status in enumerate(sequence):
+            expected = committee.elect_leader(WAVE, leader_offset)
+            assert status.kind == LeaderStatus.COMMIT
+            assert status.block.author() == expected
+
+
+def test_idempotence(committee, tmp_path):
+    for number_of_leaders in range(1, len(committee)):
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{number_of_leaders}")
+        build_dag(committee, writer, None, 5)
+        committer = make_committer(committee, writer, number_of_leaders)
+        committed = committer.try_commit(AuthorityRound(0, 0))
+        assert committed
+        last = committed[-1]
+        sequence = committer.try_commit(AuthorityRound(last.authority, last.round))
+        assert sequence == []
+
+
+def test_multiple_direct_commit(committee, tmp_path):
+    number_of_leaders = committee.quorum_threshold()
+    last_committed = AuthorityRound(0, 0)
+    for n in range(1, 11):
+        enough_blocks = WAVE * (n + 1) - 1
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{n}")
+        build_dag(committee, writer, None, enough_blocks)
+        committer = make_committer(committee, writer, number_of_leaders)
+        sequence = committer.try_commit(last_committed)
+        assert len(sequence) == number_of_leaders
+        leader_round = n * WAVE
+        for leader_offset, status in enumerate(sequence):
+            expected = committee.elect_leader(leader_round, leader_offset)
+            assert status.kind == LeaderStatus.COMMIT
+            assert status.block.author() == expected
+        last = sequence[-1]
+        last_committed = AuthorityRound(last.authority, last.round)
+
+
+def test_direct_commit_partial_round(committee, tmp_path):
+    """Resuming from mid-round commits only the remaining leaders of that round."""
+    number_of_leaders = committee.quorum_threshold()
+    first_leader_round = WAVE
+    first_leader = committee.elect_leader(first_leader_round, 0)
+    last_committed = AuthorityRound(first_leader, first_leader_round)
+
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, 2 * WAVE - 1)
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(last_committed)
+    assert len(sequence) == number_of_leaders - 1
+    for i, status in enumerate(sequence):
+        leader_offset = i + 1
+        expected = committee.elect_leader(first_leader_round, leader_offset)
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == expected
+
+
+def test_direct_commit_late_call(committee, tmp_path):
+    number_of_leaders = committee.quorum_threshold()
+    n = 10
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, WAVE * (n + 1) - 1)
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == number_of_leaders * n
+    for i in range(n):
+        chunk = sequence[i * number_of_leaders : (i + 1) * number_of_leaders]
+        leader_round = (i + 1) * WAVE
+        for leader_offset, status in enumerate(chunk):
+            expected = committee.elect_leader(leader_round, leader_offset)
+            assert status.kind == LeaderStatus.COMMIT
+            assert status.block.author() == expected
+
+
+def test_no_genesis_commit(committee, tmp_path):
+    number_of_leaders = committee.quorum_threshold()
+    for r in range(2 * WAVE - 1):
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{r}")
+        build_dag(committee, writer, None, r)
+        committer = make_committer(committee, writer, number_of_leaders)
+        assert committer.try_commit(AuthorityRound(0, 0)) == []
+
+
+def test_no_leader(committee, tmp_path):
+    """The missing first leader is skipped; other leaders of the round commit."""
+    number_of_leaders = committee.quorum_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+    references = build_dag(committee, writer, None, WAVE - 1)
+    leader_round_1 = WAVE
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    connections = [
+        (a, references) for a in committee.authority_indexes() if a != leader_1
+    ]
+    references = build_dag_layer(connections, writer)
+    build_dag(committee, writer, references, 2 * WAVE - 1)
+
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == number_of_leaders
+    for leader_offset, status in enumerate(sequence):
+        expected = committee.elect_leader(leader_round_1, leader_offset)
+        if expected == leader_1:
+            assert status.kind == LeaderStatus.SKIP
+            assert status.authority == expected
+            assert status.round == leader_round_1
+        else:
+            assert status.kind == LeaderStatus.COMMIT
+            assert status.block.author() == expected
+
+
+def test_direct_skip(committee, tmp_path):
+    number_of_leaders = committee.quorum_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+    leader_round_1 = WAVE
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+    build_dag(committee, writer, references_without_leader_1, 2 * WAVE - 1)
+
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == number_of_leaders
+    assert sequence[0].kind == LeaderStatus.SKIP
+    assert sequence[0].authority == leader_1

@@ -1,0 +1,119 @@
+"""Pipelined commit-rule gold suite — ``consensus/tests/pipelined_committer_tests.rs``.
+With pipelining every round is some committer's leader round."""
+import pytest
+
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.consensus import AuthorityRound, DEFAULT_WAVE_LENGTH, LeaderStatus
+from mysticeti_tpu.consensus.universal_committer import UniversalCommitterBuilder
+
+from helpers import DagBlockWriter, build_dag, build_dag_layer
+
+WAVE = DEFAULT_WAVE_LENGTH
+
+
+@pytest.fixture
+def committee():
+    return Committee.new_test([1, 1, 1, 1])
+
+
+def make_committer(committee, writer):
+    return (
+        UniversalCommitterBuilder(committee, writer.block_store)
+        .with_wave_length(WAVE)
+        .with_pipeline(True)
+        .build()
+    )
+
+
+def test_direct_commit(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, WAVE)
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 1
+    assert sequence[0].kind == LeaderStatus.COMMIT
+    assert sequence[0].block.author() == committee.elect_leader(1, 0)
+
+
+def test_idempotence(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, 5)
+    committer = make_committer(committee, writer)
+    committed = committer.try_commit(AuthorityRound(0, 0))
+    assert committed
+    last = committed[-1]
+    sequence = committer.try_commit(AuthorityRound(last.authority, last.round))
+    assert sequence == []
+
+
+def test_multiple_direct_commit(committee, tmp_path):
+    last_committed = AuthorityRound(0, 0)
+    for n in range(1, 11):
+        enough_blocks = n + (WAVE - 1)
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{n}")
+        build_dag(committee, writer, None, enough_blocks)
+        committer = make_committer(committee, writer)
+        sequence = committer.try_commit(last_committed)
+        assert len(sequence) == 1
+        assert sequence[0].kind == LeaderStatus.COMMIT
+        assert sequence[0].block.author() == committee.elect_leader(n, 0)
+        last = sequence[-1]
+        last_committed = AuthorityRound(last.authority, last.round)
+
+
+def test_direct_commit_late_call(committee, tmp_path):
+    n = 10
+    writer = DagBlockWriter(committee, str(tmp_path))
+    build_dag(committee, writer, None, n + WAVE - 1)
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == n
+    for i, status in enumerate(sequence):
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == committee.elect_leader(i + 1, 0)
+
+
+def test_no_genesis_commit(committee, tmp_path):
+    for r in range(WAVE):
+        writer = DagBlockWriter(committee, str(tmp_path), name=f"wal-{r}")
+        build_dag(committee, writer, None, r)
+        committer = make_committer(committee, writer)
+        assert committer.try_commit(AuthorityRound(0, 0)) == []
+
+
+def test_no_leader(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    # Round 1's leader missing: build round 1 without it, then to its decision round.
+    references = build_dag(committee, writer, None, 0)
+    leader_round_1 = 1
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    connections = [
+        (a, references) for a in committee.authority_indexes() if a != leader_1
+    ]
+    references = build_dag_layer(connections, writer)
+    build_dag(committee, writer, references, leader_round_1 + WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 1
+    assert sequence[0].kind == LeaderStatus.SKIP
+    assert sequence[0].authority == leader_1
+    assert sequence[0].round == leader_round_1
+
+
+def test_direct_skip(committee, tmp_path):
+    writer = DagBlockWriter(committee, str(tmp_path))
+    leader_round_1 = 1
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+    build_dag(committee, writer, references_without_leader_1, leader_round_1 + WAVE - 1)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 1
+    assert sequence[0].kind == LeaderStatus.SKIP
+    assert sequence[0].authority == leader_1
+    assert sequence[0].round == leader_round_1
