@@ -1,0 +1,13 @@
+"""JAX/TPU kernels for the block-verification hot path.
+
+This package is the TPU-native replacement for the reference's CPU crypto
+(``mysticeti-core/src/crypto.rs:174-189`` verify_block): batched Ed25519
+verification expressed as int32 limb arithmetic that XLA vectorizes on the
+TPU VPU, ``vmap``ped over the signature batch and shardable across chips with
+``shard_map`` (see ``mysticeti_tpu.parallel``).
+
+Modules:
+  field    — GF(2^255-19) arithmetic in 20x13-bit int32 limbs
+  ed25519  — twisted-Edwards point ops + the batched verify kernel
+  sha512   — SHA-512 compression in 32-bit lanes (fused digest+verify path)
+"""

@@ -1,0 +1,217 @@
+"""GF(2^255-19) arithmetic in 20 x 13-bit int32 limbs — TPU-native design.
+
+Why this representation (not a port of any CPU bignum):
+
+* TPUs have no 64-bit integer multiplier; the VPU does 32-bit integer ops.
+  With 13-bit limbs, a product is ≤ 2^26 and a full 20x20 schoolbook
+  anti-diagonal sum is ≤ 20·2^26 < 2^31 — every intermediate of the multiply
+  fits int32 with no in-loop carry handling.
+* 20 limbs x 13 bits = 260 bits; 2^260 ≡ 19·2^5 = 608 and 2^256 ≡ 38 (mod p),
+  so overflow limbs fold back with small constant multipliers.
+* Carry propagation is a handful of *vectorized* passes (carry magnitudes decay
+  geometrically), never a serial 255-step chain — XLA keeps the whole pipeline
+  lane-parallel, and the batch dimension vmaps across VPU lanes.
+
+Representation invariant ("partial" form) maintained by every public op:
+  limbs[0..18] ∈ [0, 2^13],  limbs[19] ∈ [0, 2^9]   (value < 2^256, may be ≥ p)
+The canonical representative in [0, p) is only produced by :func:`canonical`
+(encode/compare time).  All functions operate on ``(..., 20)`` int32 arrays and
+are ``vmap``/``jit``-safe.
+
+This replaces the dalek field arithmetic behind the reference's verify hot path
+(``mysticeti-core/src/crypto.rs:174-189``); parity is enforced against python-int
+math and the ``cryptography`` Ed25519 oracle in ``tests/test_ed25519_tpu.py``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+RADIX = 13
+NLIMBS = 20
+MASK = (1 << RADIX) - 1  # 8191
+P = (1 << 255) - 19
+FOLD_260 = 19 << 5  # 2^260 mod p = 608: limb 20+j folds to limb j
+FOLD_256 = 38  # 2^256 mod p: top-limb bits ≥ 9 fold to limb 0
+
+# Anti-diagonal scatter map for the schoolbook product, built once.
+_I, _J = np.meshgrid(np.arange(NLIMBS), np.arange(NLIMBS), indexing="ij")
+_DIAG = jnp.asarray((_I + _J).reshape(-1), dtype=jnp.int32)
+
+_WORK = 2 * NLIMBS + 2  # product workspace: 39 live limbs + carry headroom
+
+# 8p = 2^258 - 152 as a limb vector with every limb large enough to bias a
+# partial-form subtrahend: [2^13-152, 2^13-1 x18, 2^11-1].
+_BIAS_8P = jnp.asarray(
+    np.array([(1 << RADIX) - 152] + [MASK] * 18 + [(1 << 11) - 1], dtype=np.int32)
+)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: python int (< 2^260) -> limb vector."""
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0, "value exceeds 260 bits"
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side: limb vector -> python int (no reduction)."""
+    return sum(int(l) << (RADIX * i) for i, l in enumerate(np.asarray(limbs).tolist()))
+
+
+def constant(x: int) -> jnp.ndarray:
+    return jnp.asarray(int_to_limbs(x % P), dtype=jnp.int32)
+
+
+def _carry_once(x: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized signed carry pass; remainders land in [0, 2^13).
+    The carry out of the top limb is DROPPED — callers guarantee it is zero."""
+    c = x >> RADIX  # floor division: correct for negative limbs too
+    x = x - (c << RADIX)
+    return x + jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+
+
+def _normalize_top(x: jnp.ndarray) -> jnp.ndarray:
+    """Restore the tight invariant: fold top-limb bits ≥ 9 (value bits ≥ 256)
+    into limb 0 with factor 38, then one carry pass.  Requires value < 2^269."""
+    c = x[..., NLIMBS - 1] >> 9
+    x = x.at[..., NLIMBS - 1].add(-(c << 9))
+    x = x.at[..., 0].add(FOLD_256 * c)
+    return _carry_once(x)
+
+
+def _fold_reduce(wide: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a ``_WORK``-limb non-negative value (limbs < 2^31) to partial form."""
+    # Three passes bring every limb below 2^13(+1); carries decay 2^18 -> 2^5 -> 1.
+    x = _carry_once(_carry_once(_carry_once(wide)))
+    lo = x[..., :NLIMBS]
+    hi = x[..., NLIMBS : 2 * NLIMBS]
+    top = x[..., 2 * NLIMBS :]  # limbs 40,41 (tiny): fold twice => factor 608^2
+    lo = lo + FOLD_260 * hi
+    lo = lo.at[..., :2].add(FOLD_260 * FOLD_260 * top)
+    # lo limbs ≤ 2^13 + 608·2^13 + 608^2·2^5 < 2^24: carry in a 21-limb
+    # workspace so the overflow out of limb 19 is captured, then folded (608).
+    lo = jnp.concatenate([lo, jnp.zeros_like(lo[..., :1])], axis=-1)
+    lo = _carry_once(_carry_once(lo))  # second pass clears limb-19 overflow
+    lo = lo[..., :NLIMBS].at[..., 0].add(FOLD_260 * lo[..., NLIMBS])
+    lo = _carry_once(lo)
+    return _normalize_top(lo)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply: schoolbook outer product + anti-diagonal scatter-add."""
+    prod = a[..., :, None] * b[..., None, :]  # (..., 20, 20), each ≤ 2^26
+    flat = prod.reshape(*prod.shape[:-2], NLIMBS * NLIMBS)
+    wide = jnp.zeros((*flat.shape[:-1], _WORK), dtype=jnp.int32)
+    wide = wide.at[..., _DIAG].add(flat)
+    return _fold_reduce(wide)
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b in partial form (sum < 2^257: carries stay in range)."""
+    return _normalize_top(_carry_once(a + b))
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (mod p): bias by 8p so the total is positive; signed carries fix the
+    few slightly-negative low limbs."""
+    x = a + _BIAS_8P - b
+    x = _carry_once(_carry_once(x))
+    return _normalize_top(x)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small non-negative constant (k < 2^17)."""
+    wide = jnp.zeros((*a.shape[:-1], _WORK), dtype=jnp.int32)
+    wide = wide.at[..., :NLIMBS].set(a * k)
+    return _fold_reduce(wide)
+
+
+def pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a^(2^k): k repeated squarings (fori_loop keeps the graph small)."""
+    return jax.lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def _ladder(z: jnp.ndarray):
+    """Shared prefix of the inversion / sqrt addition chains: returns
+    (z11, z^(2^50-1), z^(2^250-1))."""
+    z2 = square(z)
+    z9 = mul(square(square(z2)), z)
+    z11 = mul(z9, z2)
+    z2_5_0 = mul(square(z11), z9)  # 2^5 - 1
+    z2_10_0 = mul(pow2k(z2_5_0, 5), z2_5_0)
+    z2_20_0 = mul(pow2k(z2_10_0, 10), z2_10_0)
+    z2_40_0 = mul(pow2k(z2_20_0, 20), z2_20_0)
+    z2_50_0 = mul(pow2k(z2_40_0, 10), z2_10_0)
+    z2_100_0 = mul(pow2k(z2_50_0, 50), z2_50_0)
+    z2_200_0 = mul(pow2k(z2_100_0, 100), z2_100_0)
+    z2_250_0 = mul(pow2k(z2_200_0, 50), z2_50_0)
+    return z11, z2_50_0, z2_250_0
+
+
+def invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) = z^(2^255-21) (classic curve25519 chain; 254 squarings)."""
+    z11, _, z2_250_0 = _ladder(z)
+    return mul(pow2k(z2_250_0, 5), z11)
+
+
+def pow22523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252-3) — the decompression square-root exponent."""
+    _, _, z2_250_0 = _ladder(z)
+    return mul(pow2k(z2_250_0, 2), z)
+
+
+# p in limb form, for the final conditional subtract of canonical().
+_P_LIMBS = jnp.asarray(
+    np.array([(1 << RADIX) - 19] + [MASK] * 18 + [255], dtype=np.int32)
+)
+
+
+def _full_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Enough carry passes for a worst-case full ripple (e.g. p -> 2^255 form)."""
+    return jax.lax.fori_loop(0, NLIMBS + 1, lambda _, v: _carry_once(v), x)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce partial limbs to the canonical representative in [0, p)."""
+    # Fold bits ≥ 255 (factor 19), fully normalize, twice: value -> [0, 2^255).
+    for _ in range(2):
+        c = x[..., NLIMBS - 1] >> 8
+        x = x.at[..., NLIMBS - 1].add(-(c << 8))
+        x = x.at[..., 0].add(19 * c)
+        x = _full_carry(x)
+    # x is now the unique normalized form of a value < 2^255; subtract p iff ≥ p
+    # (exact limb comparison — all mid limbs saturated and low limb ≥ p's).
+    ge_p = (
+        (x[..., NLIMBS - 1] == 255)
+        & jnp.all(x[..., 1 : NLIMBS - 1] == MASK, axis=-1)
+        & (x[..., 0] >= (1 << RADIX) - 19)
+    )
+    return jnp.where(ge_p[..., None], x - _P_LIMBS, x)
+
+
+def eq_canonical(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Equality of field elements given in partial form (bool, batch-shaped)."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Least significant bit of the canonical representative (the sign bit)."""
+    return canonical(a)[..., 0] & 1

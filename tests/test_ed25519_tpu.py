@@ -1,0 +1,218 @@
+"""JAX Ed25519 verifier parity suite — the crypto-parity tier the reference never
+needed (SURVEY §4): RFC 8032 vectors + randomized accept/reject agreement with the
+``cryptography`` (OpenSSL) oracle, plus field-arithmetic unit checks."""
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from mysticeti_tpu.ops import ed25519 as E
+from mysticeti_tpu.ops import field as F
+
+P = F.P
+
+
+# ---------------------------------------------------------------------------
+# Field arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _limbs(x):
+    return jnp.asarray(F.int_to_limbs(x % P))
+
+
+def test_field_roundtrip_and_ops():
+    import jax
+
+    rng = random.Random(7)
+    cases = [rng.randrange(P) for _ in range(20)] + [0, 1, P - 1, P - 19, 2**255 - 20]
+
+    @jax.jit
+    def all_ops(A, B):
+        vc = jax.vmap(F.canonical)
+        return (
+            vc(jax.vmap(F.mul)(A, B)),
+            vc(jax.vmap(F.add)(A, B)),
+            vc(jax.vmap(F.sub)(A, B)),
+        )
+
+    a_vals = cases
+    b_vals = list(reversed(cases))
+    A = jnp.stack([_limbs(a) for a in a_vals])
+    B = jnp.stack([_limbs(b) for b in b_vals])
+    got_mul, got_add, got_sub = all_ops(A, B)
+    for i, (a, b) in enumerate(zip(a_vals, b_vals)):
+        assert F.limbs_to_int(got_mul[i]) == a * b % P
+        assert F.limbs_to_int(got_add[i]) == (a + b) % P
+        assert F.limbs_to_int(got_sub[i]) == (a - b) % P
+
+
+def test_field_invert_and_sqrt_exponent():
+    import jax
+
+    rng = random.Random(8)
+    a = rng.randrange(1, P)
+    A = _limbs(a)
+
+    @jax.jit
+    def both(A):
+        return F.canonical(F.invert(A)), F.canonical(F.pow22523(A))
+
+    inv, sqrt_e = both(A)
+    assert F.limbs_to_int(inv) == pow(a, P - 2, P)
+    assert F.limbs_to_int(sqrt_e) == pow(a, (P - 5) // 8, P)
+
+
+def test_field_partial_form_chain():
+    """Long chains of ops keep the partial-form invariant (no int32 overflow)."""
+    import jax
+
+    rng = random.Random(9)
+    a = rng.randrange(P)
+
+    @jax.jit
+    def chain(A):
+        def body(_, st):
+            A, max_limb = st
+            A = F.mul(A, F.sub(A, F.add(A, A)))
+            return A, jnp.maximum(max_limb, jnp.max(A))
+
+        return jax.lax.fori_loop(0, 60, body, (A, jnp.int32(0)))
+
+    A, max_limb = chain(_limbs(a))
+    x = a
+    for _ in range(60):
+        x = (x * ((x - 2 * x) % P)) % P
+    assert int(max_limb) <= (1 << 13) + 64
+    assert F.limbs_to_int(F.canonical(A)) == x
+
+
+# ---------------------------------------------------------------------------
+# RFC 8032 vectors
+# ---------------------------------------------------------------------------
+
+RFC8032_VECTORS = [
+    # (public key, message, signature) hex
+    (
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e0652249015"
+        "55fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+def test_rfc8032_vectors():
+    pks = [bytes.fromhex(pk) for pk, _, _ in RFC8032_VECTORS]
+    msgs = [bytes.fromhex(m) for _, m, _ in RFC8032_VECTORS]
+    sigs = [bytes.fromhex(s) for _, _, s in RFC8032_VECTORS]
+    assert E.verify_batch(pks, msgs, sigs).all()
+
+
+def test_rfc8032_corrupted():
+    pks = [bytes.fromhex(pk) for pk, _, _ in RFC8032_VECTORS]
+    msgs = [bytes.fromhex(m) for _, m, _ in RFC8032_VECTORS]
+    sigs = [bytearray(bytes.fromhex(s)) for _, _, s in RFC8032_VECTORS]
+    sigs[0][3] ^= 0x40  # corrupt R
+    sigs[1][40] ^= 0x01  # corrupt S
+    msgs[2] = msgs[2] + b"x"  # corrupt message
+    res = E.verify_batch(pks, msgs, [bytes(s) for s in sigs])
+    assert not res.any()
+
+
+# ---------------------------------------------------------------------------
+# Randomized parity with the OpenSSL oracle
+# ---------------------------------------------------------------------------
+
+
+def _oracle_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+
+    try:
+        Ed25519PublicKey.from_public_bytes(pk).verify(sig, msg)
+        return True
+    except Exception:
+        return False
+
+
+def test_randomized_oracle_parity():
+    """Accept/reject must agree bit-for-bit with OpenSSL over a mixed batch of
+    valid, corrupted, wrong-key, and garbage signatures (BASELINE config #2)."""
+    rng = random.Random(1234)
+    pks, msgs, sigs = [], [], []
+    for i in range(48):
+        key = Ed25519PrivateKey.from_private_bytes(bytes(rng.randrange(256) for _ in range(32)))
+        pk = key.public_key().public_bytes_raw()
+        msg = bytes(rng.randrange(256) for _ in range(32))
+        sig = key.sign(msg)
+        mode = i % 6
+        if mode == 1:
+            sig = bytearray(sig)
+            sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sig = bytes(sig)
+        elif mode == 2:
+            msg = bytes(rng.randrange(256) for _ in range(32))  # different message
+        elif mode == 3:
+            other = Ed25519PrivateKey.generate()
+            pk = other.public_key().public_bytes_raw()  # wrong key
+        elif mode == 4:
+            sig = bytes(rng.randrange(256) for _ in range(64))  # garbage sig
+        elif mode == 5:
+            pk = bytes(rng.randrange(256) for _ in range(32))  # garbage key
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sig)
+
+    ours = E.verify_batch(pks, msgs, sigs)
+    oracle = np.array([_oracle_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)])
+    assert (ours == oracle).all(), (
+        f"mismatch at {np.nonzero(ours != oracle)[0]}: ours={ours} oracle={oracle}"
+    )
+
+
+def test_noncanonical_s_rejected():
+    key = Ed25519PrivateKey.generate()
+    pk = key.public_key().public_bytes_raw()
+    msg = b"m" * 32
+    sig = bytearray(key.sign(msg))
+    s = int.from_bytes(sig[32:], "little") + E.L  # s' = s + L: same equation mod L
+    if s < 2**256:
+        sig[32:] = s.to_bytes(32, "little")
+        res = E.verify_batch([pk], [msg], [bytes(sig)])
+        assert not res[0], "malleable s must be rejected"
+
+
+def test_block_signature_integration():
+    """The verifier accepts real block signatures produced by the framework's
+    Signer over the signed digest (crypto.rs:199-223 layering)."""
+    from mysticeti_tpu import crypto
+    from mysticeti_tpu.types import StatementBlock
+
+    signer = crypto.Signer.from_seed(b"tpu-integration-test-seed-000000")
+    blocks = [
+        StatementBlock.build(0, r, [], (), signer=signer) for r in range(1, 9)
+    ]
+    pks = [signer.public_key.bytes] * len(blocks)
+    msgs = [b.signed_digest() for b in blocks]
+    sigs = [b.signature for b in blocks]
+    assert E.verify_batch(pks, msgs, sigs).all()
+    # And rejects a signature transplanted between blocks.
+    sigs[0] = blocks[1].signature
+    assert not E.verify_batch(pks, msgs, sigs)[0]
