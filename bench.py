@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Headline benchmark: batched Ed25519 verification throughput on the default
+JAX device (the real TPU chip under the driver; CPU elsewhere).
+
+Prints exactly ONE JSON line:
+  {"metric": "ed25519_verifies_per_sec", "value": N, "unit": "sig/s", "vs_baseline": R}
+
+``vs_baseline`` is measured against the BASELINE.json north-star target of
+500k sig-verifies/sec/host (the reference itself publishes no number — its
+dalek CPU path verifies serially per block, ~15-25k/s/core).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/mysticeti-tpu-jax-cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_TARGET = 500_000.0  # sig-verifies/sec/host (BASELINE.json north star)
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    from mysticeti_tpu.ops import ed25519 as E
+
+    batch = int(os.environ.get("BENCH_BATCH", "2048"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+
+    # Build a realistic batch: distinct signers over 32-byte block digests
+    # (the framework's signed message is always a blake2b-256 digest).
+    import random
+
+    rng = random.Random(0)
+    n_keys = 16
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(bytes(rng.randrange(256) for _ in range(32)))
+        for _ in range(n_keys)
+    ]
+    pks, msgs, sigs = [], [], []
+    for i in range(batch):
+        key = keys[i % n_keys]
+        msg = bytes(rng.randrange(256) for _ in range(32))
+        pks.append(key.public_key().public_bytes_raw())
+        msgs.append(msg)
+        sigs.append(key.sign(msg))
+
+    packed = [jnp.asarray(x) for x in E.pack_batch(pks, msgs, sigs)]
+
+    # Warm-up / compile.
+    ok = E.verify_kernel(*packed)
+    ok.block_until_ready()
+    assert bool(np.asarray(ok).all()), "benchmark batch must verify"
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        ok = E.verify_kernel(*packed)
+    ok.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    value = batch * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verifies_per_sec",
+                "value": round(value, 1),
+                "unit": "sig/s",
+                "vs_baseline": round(value / BASELINE_TARGET, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

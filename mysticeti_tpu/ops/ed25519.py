@@ -170,8 +170,7 @@ def _double_scalar_mul(
     return acc
 
 
-@jax.jit
-def verify_kernel(
+def verify_impl(
     a_y: jnp.ndarray,  # (B, 20) public key y limbs
     a_sign: jnp.ndarray,  # (B,)
     r_y: jnp.ndarray,  # (B, 20) signature R y limbs (raw, unvalidated)
@@ -192,6 +191,9 @@ def verify_kernel(
     # non-canonical R can never equal the canonical encoding -> rejected.
     match = F.eq_canonical(y_aff, r_y) & (F.parity(x_aff) == r_sign)
     return match & decompress_ok & host_ok
+
+
+verify_kernel = jax.jit(verify_impl)
 
 
 # ---------------------------------------------------------------------------

@@ -97,8 +97,13 @@ def _fold_reduce(wide: jnp.ndarray) -> jnp.ndarray:
     # lo limbs ≤ 2^13 + 608·2^13 + 608^2·2^5 < 2^24: carry in a 21-limb
     # workspace so the overflow out of limb 19 is captured, then folded (608).
     lo = jnp.concatenate([lo, jnp.zeros_like(lo[..., :1])], axis=-1)
-    lo = _carry_once(_carry_once(lo))  # second pass clears limb-19 overflow
+    lo = _carry_once(_carry_once(lo))
     lo = lo[..., :NLIMBS].at[..., 0].add(FOLD_260 * lo[..., NLIMBS])
+    # Limb 19 can still hold exactly 2^13 here (carry ripple landed on a full
+    # limb); fold its bits ≥ 13 explicitly — _carry_once would DROP them.
+    c = lo[..., NLIMBS - 1] >> RADIX
+    lo = lo.at[..., NLIMBS - 1].add(-(c << RADIX))
+    lo = lo.at[..., 0].add(FOLD_260 * c)
     lo = _carry_once(lo)
     return _normalize_top(lo)
 

@@ -1,0 +1,13 @@
+"""Multi-chip execution: mesh construction + sharded batch verification.
+
+The reference scales with a NCCL-free TCP mesh between validators
+(``network.rs``) and has no intra-validator accelerator parallelism.  Here the
+TPU-native story (SURVEY §2.5): consensus traffic stays on the host NIC
+(trust-domain boundary), while *inside* one validator the verification batch is
+sharded across the chips of a pod slice with ``shard_map`` — pure data
+parallelism over the batch axis, plus an ICI ``psum`` for the aggregate
+valid-count that the vote tally consumes.
+"""
+from .mesh import make_mesh, sharded_verify_kernel, sharded_verify_batch
+
+__all__ = ["make_mesh", "sharded_verify_kernel", "sharded_verify_batch"]

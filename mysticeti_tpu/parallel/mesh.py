@@ -1,0 +1,77 @@
+"""Device mesh + shard_map wrapper for the Ed25519 batch verifier.
+
+Design: the verify kernel is embarrassingly parallel over the batch, so the
+mesh is one axis (``batch``) and every input is sharded along it; XLA runs one
+shard per chip over ICI with no inter-chip traffic except the final ``psum``
+that reduces the per-shard valid counts (the quantity the consensus vote
+aggregator actually needs globally).
+
+Tested on a virtual 8-device CPU mesh (``--xla_force_host_platform_device_count``)
+— the same mesh/collective compilation path XLA uses on a real slice.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+from ..ops import ed25519 as E
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices (axis: ``batch``)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=("batch",))
+
+
+def sharded_verify_kernel(mesh: Mesh):
+    """Returns a jitted fn(packed arrays) -> (per-item bool, global valid count).
+
+    All inputs are sharded on the leading batch axis; the valid-count reduction
+    is an ICI ``psum``.  Batch size must be a multiple of the mesh size.
+    """
+    spec = PSpec("batch")
+
+    def _shard_body(a_y, a_sign, r_y, r_sign, s_bits, k_bits, host_ok):
+        ok = E.verify_impl(a_y, a_sign, r_y, r_sign, s_bits, k_bits, host_ok)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), "batch")
+        return ok, total
+
+    sharded = shard_map(
+        _shard_body,
+        mesh=mesh,
+        in_specs=(spec,) * 7,
+        out_specs=(spec, PSpec()),
+        check_rep=False,
+    )
+    return jax.jit(sharded)
+
+
+def sharded_verify_batch(
+    mesh: Mesh,
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> Tuple[np.ndarray, int]:
+    """Host convenience: pack, pad to the mesh-aligned bucket, dispatch sharded."""
+    n = len(signatures)
+    n_dev = mesh.devices.size
+    kernel = sharded_verify_kernel(mesh)
+    packed = E.pack_batch(public_keys, messages, signatures)
+    per_shard = max(1, -(-n // n_dev))
+    padded = per_shard * n_dev
+    arrs = []
+    for x in packed:
+        pad = padded - n
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        arrs.append(jnp.asarray(np.pad(x, widths)))
+    ok, total = kernel(*arrs)
+    return np.asarray(ok)[:n], int(total)

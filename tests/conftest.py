@@ -7,12 +7,18 @@ before jax is first imported anywhere in the test session.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin ignores the env var alone; pin the platform through the
+# config API as well (must run before any backend is initialized).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

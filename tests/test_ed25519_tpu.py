@@ -52,6 +52,21 @@ def test_field_roundtrip_and_ops():
         assert F.limbs_to_int(got_sub[i]) == (a - b) % P
 
 
+def test_field_mul_carry_saturation_regression():
+    """Regression: a product whose carry ripple lands a full 2^13 on limb 19 —
+    the dropped-carry bug found via a real signature (all-zero-seed key,
+    msg=0x3f*32).  The reduction must fold that bit, not drop it."""
+    e = [3118, 7793, 4844, 2951, 244, 530, 1793, 2089, 4981, 369,
+         7492, 2771, 7811, 8145, 3290, 7683, 2110, 4276, 4727, 297]
+    f = [6206, 1368, 1220, 7754, 597, 386, 3963, 7916, 5491, 5782,
+         3507, 4421, 4725, 3696, 3677, 6152, 1606, 7840, 8029, 388]
+    A = jnp.asarray(np.array(e, np.int32))
+    B = jnp.asarray(np.array(f, np.int32))
+    got = F.limbs_to_int(F.canonical(F.mul(A, B)))
+    want = F.limbs_to_int(A) * F.limbs_to_int(B) % P
+    assert got == want
+
+
 def test_field_invert_and_sqrt_exponent():
     import jax
 
