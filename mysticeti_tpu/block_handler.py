@@ -189,6 +189,8 @@ class TestBlockHandler(BlockHandler):
     """Immediately votes and generates one new transaction per call
     (block_handler.rs:224-333)."""
 
+    __test__ = False  # not a pytest class
+
     def __init__(
         self,
         last_transaction: int,

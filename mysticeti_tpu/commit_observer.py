@@ -1,0 +1,136 @@
+"""Commit observers: consume committed leaders, produce ordered sub-dags.
+
+Capability parity with ``mysticeti-core/src/commit_observer.rs``:
+
+* ``CommitObserver`` interface {handle_commit, aggregator_state} (:23-32)
+* ``TestCommitObserver`` (:42-198) — benchmark observer: linearizes commits,
+  tallies committed transactions through a TransactionAggregator, records the
+  benchmark-defining latency metrics (latency_s{shared}, latency_squared_s,
+  benchmark_duration), tracks committed leaders.
+* ``SimpleCommitObserver`` (:200-290) — production observer: forwards sub-dags
+  to an application queue; on recovery re-sends commits above the consumer's
+  ``last_sent_height``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from .block_store import BlockStore
+from .committee import Committee, QUORUM, TransactionAggregator
+from .consensus.linearizer import CommittedSubDag, Linearizer
+from .state import CommitObserverRecoveredState
+from .types import BlockReference, StatementBlock, TransactionLocator
+
+
+class CommitObserver:
+    def handle_commit(
+        self, committed_leaders: List[StatementBlock]
+    ) -> List[CommittedSubDag]:
+        raise NotImplementedError
+
+    def aggregator_state(self) -> bytes:
+        raise NotImplementedError
+
+
+class TestCommitObserver(CommitObserver):
+    """Benchmark/test observer (commit_observer.rs:42-198)."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(
+        self,
+        block_store: BlockStore,
+        committee: Committee,
+        transaction_time: Optional[Dict[TransactionLocator, float]] = None,
+        metrics=None,
+        handler=None,
+        recovered_state: Optional[CommitObserverRecoveredState] = None,
+    ) -> None:
+        self.commit_interpreter = Linearizer(block_store)
+        self.transaction_votes = handler or TransactionAggregator(QUORUM)
+        self.committee = committee
+        self.committed_leaders: List[BlockReference] = []
+        self.start_time = time.monotonic()
+        self.transaction_time = transaction_time if transaction_time is not None else {}
+        self.metrics = metrics
+        self.consensus_only = "CONSENSUS_ONLY" in os.environ
+        if recovered_state is not None:
+            self._recover_committed(recovered_state)
+
+    def _recover_committed(self, recovered: CommitObserverRecoveredState) -> None:
+        if recovered.state is not None:
+            self.transaction_votes.with_state(recovered.state)
+        else:
+            assert not recovered.sub_dags
+        self.commit_interpreter.recover_state(recovered)
+
+    def handle_commit(self, committed_leaders):
+        now = time.time()
+        committed = self.commit_interpreter.handle_commit(committed_leaders)
+        for commit in committed:
+            self.committed_leaders.append(commit.anchor)
+            for block in commit.blocks:
+                if not self.consensus_only:
+                    self.transaction_votes.process_block(block, None, self.committee)
+                if self.metrics is not None:
+                    for locator, transaction in block.shared_transactions():
+                        self._update_metrics(transaction, now)
+        return committed
+
+    def _update_metrics(self, transaction: bytes, now: float) -> None:
+        """Benchmark metrics (commit_observer.rs:104-140): latency measured from
+        the 8-byte submission timestamp the generator prefixes to each tx."""
+        elapsed = time.monotonic() - self.start_time
+        delta = int(elapsed) - int(self.metrics.benchmark_duration._value.get())
+        if delta > 0:
+            self.metrics.benchmark_duration.inc(delta)
+        from .transactions_generator import TransactionGenerator
+
+        ts = TransactionGenerator.extract_timestamp(transaction)
+        latency = max(0.0, now - ts) if ts else 0.0
+        self.metrics.latency_s.labels("shared").observe(latency)
+        self.metrics.latency_squared_s.labels("shared").inc(latency**2)
+
+    def aggregator_state(self) -> bytes:
+        return self.transaction_votes.state()
+
+
+class SimpleCommitObserver(CommitObserver):
+    """Production observer: forward sub-dags to the application
+    (commit_observer.rs:200-290)."""
+
+    def __init__(
+        self,
+        block_store: BlockStore,
+        sender: Callable[[CommittedSubDag], None],
+        last_sent_height: int = 0,
+        recovered_state: Optional[CommitObserverRecoveredState] = None,
+        metrics=None,
+    ) -> None:
+        self.block_store = block_store
+        self.commit_interpreter = Linearizer(block_store)
+        self.sender = sender
+        self.metrics = metrics
+        if recovered_state is not None:
+            self._recover_committed(last_sent_height, recovered_state)
+
+    def _recover_committed(
+        self, last_sent_height: int, recovered: CommitObserverRecoveredState
+    ) -> None:
+        self.commit_interpreter.recover_state(recovered)
+        for commit_data in recovered.sub_dags:
+            if commit_data.height > last_sent_height:
+                self.sender(
+                    CommittedSubDag.new_from_commit_data(commit_data, self.block_store)
+                )
+
+    def handle_commit(self, committed_leaders):
+        committed = self.commit_interpreter.handle_commit(committed_leaders)
+        for commit in committed:
+            self.sender(commit)
+        return committed
+
+    def aggregator_state(self) -> bytes:
+        return b""

@@ -200,12 +200,14 @@ class Core:
                     statements.extend(meta.statements)
 
         assert includes
+        from .runtime import timestamp_utc
+
         block = StatementBlock.build(
             self.authority,
             clock_round,
             includes,
             statements,
-            meta_creation_time_ns=time.time_ns(),
+            meta_creation_time_ns=int(timestamp_utc() * 1e9),
             epoch_marker=1 if self.epoch_changing() else 0,
             epoch=self.committee.epoch,
             signer=self.signer,

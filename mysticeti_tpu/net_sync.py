@@ -1,0 +1,269 @@
+"""Node-level orchestration: connections, verify-then-add pipeline, timeouts.
+
+Capability parity with ``mysticeti-core/src/net_sync.rs``:
+
+* ``NetworkSyncer.start`` (:80-167) — Syncer + Signals, core dispatcher,
+  connection accept loop, leader-timeout task, periodic cleanup task, WAL
+  fsync thread.
+* per-peer ``connection_task`` (:237-312) — subscribe to the peer's own blocks
+  from our last-seen round, dispatch incoming messages.
+* ``process_blocks`` (:314-386) — dedup via the core task, consensus-rule
+  verification, then the pluggable ``BlockVerifier`` — here the
+  **batched TPU signature path** (the reference verifies serially per
+  connection; this framework batches across connections, block_validator.py).
+* leader timeout (:401-444), cleanup every 10 s (:446-459), epoch-aware
+  shutdown (:466-494), ``AsyncWalSyncer`` 1 s fsync cadence (:496-560).
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, List, Optional, Set
+
+from .block_validator import AcceptAllBlockVerifier, BlockVerifier
+from .commit_observer import CommitObserver
+from .config import Parameters
+from .core import Core
+from .core_task import CoreTaskDispatcher
+from .network import (
+    BlockNotFound,
+    Blocks,
+    Connection,
+    RequestBlocks,
+    RequestBlocksResponse,
+    SubscribeOwnFrom,
+)
+from .syncer import Syncer, SyncerSignals
+from .synchronizer import BlockDisseminator, BlockFetcher
+from .types import AuthoritySet, StatementBlock, VerificationError
+
+CLEANUP_INTERVAL_S = 10.0
+
+
+class AsyncSignals(SyncerSignals):
+    """Signals backed by asyncio primitives (syncer.rs:24-52)."""
+
+    def __init__(self) -> None:
+        self.block_ready = asyncio.Event()
+        self.round_advanced = asyncio.Condition()
+        self.current_round = 0
+
+    def new_block_ready(self) -> None:
+        self.block_ready.set()
+        # Re-arm on the next loop tick so stream tasks level-trigger.
+        asyncio.get_event_loop().call_soon(self.block_ready.clear)
+
+    def new_round(self, round_: int) -> None:
+        self.current_round = round_
+
+        async def notify():
+            async with self.round_advanced:
+                self.round_advanced.notify_all()
+
+        asyncio.ensure_future(notify())
+
+
+class NetworkSyncer:
+    def __init__(
+        self,
+        core: Core,
+        commit_observer: CommitObserver,
+        network,  # TcpNetwork-like: .connections queue
+        parameters: Optional[Parameters] = None,
+        block_verifier: Optional[BlockVerifier] = None,
+        metrics=None,
+        start_wal_sync_thread: bool = False,
+    ) -> None:
+        self.parameters = parameters or Parameters()
+        self.signals = AsyncSignals()
+        self.syncer = Syncer(
+            core,
+            self.parameters.wave_length,
+            self.signals,
+            commit_observer,
+            metrics,
+        )
+        self.core = core
+        self.network = network
+        self.block_verifier = block_verifier or AcceptAllBlockVerifier()
+        self.metrics = metrics
+        self.dispatcher = CoreTaskDispatcher(self.syncer)
+        self.connections: Dict[int, Connection] = {}
+        self.connected_authorities = AuthoritySet()
+        self.fetcher = BlockFetcher(
+            core.authority,
+            self.dispatcher,
+            self.connections,
+            self.parameters.synchronizer,
+            metrics,
+        )
+        self._tasks: List[asyncio.Task] = []
+        self._disseminators: Dict[int, BlockDisseminator] = {}
+        self._stopped = asyncio.Event()
+        self._wal_sync_thread: Optional[threading.Thread] = None
+        self._start_wal_sync_thread = start_wal_sync_thread
+
+    # -- lifecycle --
+
+    async def start(self) -> "NetworkSyncer":
+        self.dispatcher.start()
+        self.connected_authorities.insert(self.core.authority)
+        # Initial proposal attempt (validator genesis kick, net_sync.rs:97).
+        await self.dispatcher.force_new_block(1, self.connected_authorities.copy())
+        self._tasks.append(asyncio.ensure_future(self._accept_loop()))
+        self._tasks.append(asyncio.ensure_future(self._leader_timeout_task()))
+        self._tasks.append(asyncio.ensure_future(self._cleanup_task()))
+        self.fetcher.start()
+        if self._start_wal_sync_thread:
+            self._start_wal_syncer()
+        return self
+
+    def _start_wal_syncer(self) -> None:
+        """Dedicated fsync thread, 1 s cadence (net_sync.rs:496-560)."""
+        syncer = self.core.wal_syncer()
+        stop = self._stopped
+
+        def run():
+            import time as _time
+
+            while not stop.is_set():
+                _time.sleep(1.0)
+                try:
+                    syncer.sync()
+                except OSError:
+                    return
+
+        self._wal_sync_thread = threading.Thread(
+            target=run, name="wal-syncer", daemon=True
+        )
+        self._wal_sync_thread.start()
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        self.fetcher.stop()
+        for d in self._disseminators.values():
+            d.stop()
+        for t in self._tasks:
+            t.cancel()
+        self.dispatcher.stop()
+        for c in self.connections.values():
+            c.close()
+        if hasattr(self.network, "stop"):
+            await self.network.stop()
+
+    async def await_completion(self) -> None:
+        await self._stopped.wait()
+
+    # -- connection handling --
+
+    async def _accept_loop(self) -> None:
+        while True:
+            connection: Connection = await self.network.connections.get()
+            self._tasks.append(
+                asyncio.ensure_future(self._connection_task(connection))
+            )
+
+    async def _connection_task(self, connection: Connection) -> None:
+        """net_sync.rs:237-312."""
+        peer = connection.peer
+        self.connections[peer] = connection
+        self.connected_authorities.insert(peer)
+        disseminator = BlockDisseminator(
+            connection,
+            self.core.block_store,
+            self.signals.block_ready,
+            self.parameters.synchronizer,
+            self.metrics,
+        )
+        self._disseminators[peer] = disseminator
+        # Ask the peer for its own blocks we have not yet seen.
+        last_seen = self.core.block_store.last_seen_by_authority(peer)
+        await connection.send(SubscribeOwnFrom(last_seen))
+        try:
+            while True:
+                msg = await connection.recv()
+                if msg is None:
+                    break
+                if isinstance(msg, SubscribeOwnFrom):
+                    disseminator.subscribe_own_from(msg.round)
+                elif isinstance(msg, Blocks):
+                    await self._process_blocks(msg.blocks)
+                elif isinstance(msg, RequestBlocks):
+                    await disseminator.send_requested(list(msg.references))
+                elif isinstance(msg, RequestBlocksResponse):
+                    await self._process_blocks(msg.blocks)
+                elif isinstance(msg, BlockNotFound):
+                    if self.metrics is not None:
+                        self.metrics.block_sync_requests_failed.inc(
+                            len(msg.references)
+                        )
+        finally:
+            disseminator.stop()
+            self._disseminators.pop(peer, None)
+            if self.connections.get(peer) is connection:
+                del self.connections[peer]
+            connection.close()
+
+    # -- the receive pipeline (net_sync.rs:314-386) --
+
+    async def _process_blocks(self, serialized_blocks) -> None:
+        blocks: List[StatementBlock] = []
+        for raw in serialized_blocks:
+            try:
+                block = StatementBlock.from_bytes(raw)
+            except Exception:
+                continue  # malformed: drop (byzantine peer)
+            blocks.append(block)
+        if not blocks:
+            return
+        # Dedup through the core task before paying for verification.
+        processed = await self.dispatcher.processed([b.reference for b in blocks])
+        fresh = [b for b, done in zip(blocks, processed) if not done]
+        verified: List[StatementBlock] = []
+        for block in fresh:
+            try:
+                block.verify_structure(self.core.committee)
+            except VerificationError:
+                continue
+            verified.append(block)
+        if not verified:
+            return
+        # Signature + application check through the pluggable verifier
+        # (batched across connections on TPU).
+        results = await self.block_verifier.verify_blocks(verified)
+        accepted = [b for b, ok in zip(verified, results) if ok]
+        if not accepted:
+            return
+        missing = await self.dispatcher.add_blocks(
+            accepted, self.connected_authorities.copy()
+        )
+        if missing:
+            # Request missing causal history from whoever sent us the children.
+            for peer, conn in list(self.connections.items()):
+                conn.try_send(RequestBlocks(tuple(missing[:50])))
+                break
+
+    # -- background tasks --
+
+    async def _leader_timeout_task(self) -> None:
+        """net_sync.rs:401-444: force a proposal if the round stalls."""
+        timeout = self.parameters.leader_timeout_s
+        while True:
+            round_at_start = self.signals.current_round
+            try:
+                async with self.signals.round_advanced:
+                    await asyncio.wait_for(
+                        self.signals.round_advanced.wait(), timeout=timeout
+                    )
+            except asyncio.TimeoutError:
+                if self.core.epoch_closed():
+                    continue
+                await self.dispatcher.force_new_block(
+                    round_at_start + 1, self.connected_authorities.copy()
+                )
+
+    async def _cleanup_task(self) -> None:
+        while True:
+            await asyncio.sleep(CLEANUP_INTERVAL_S)
+            if self.parameters.enable_cleanup:
+                await self.dispatcher.cleanup()

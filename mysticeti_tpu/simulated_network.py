@@ -1,0 +1,109 @@
+"""In-memory network with seeded random latency and partition control.
+
+Capability parity with ``mysticeti-core/src/simulated_network.rs``: connection
+pairs among all committee members with 50-100 ms one-way latency injected per
+message (:14-95), plus explicit partition/heal control used by the partition
+sim-test (net_sync.rs:753-780).
+
+Drop-in for :class:`mysticeti_tpu.network.TcpNetwork`: exposes the same
+``connections`` queue of :class:`Connection` objects.  Message delivery is a
+``loop.call_later`` on the DeterministicLoop, so ordering is reproducible by
+seed.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Set, Tuple
+
+from .network import Connection, NetworkMessage
+
+
+class SimulatedNetwork:
+    LATENCY_RANGE = (0.050, 0.100)  # one-way seconds (simulated_network.rs:20)
+
+    def __init__(self, num_authorities: int) -> None:
+        self.n = num_authorities
+        # per-node queue of fresh connections (what TcpNetwork.connections is).
+        self.node_connections: List[asyncio.Queue] = [
+            asyncio.Queue() for _ in range(num_authorities)
+        ]
+        self._links: Dict[Tuple[int, int], tuple] = {}  # (ca, cb, pump_a, pump_b)
+        self._severed: Set[Tuple[int, int]] = set()
+
+    async def connect_all(self) -> None:
+        for a in range(self.n):
+            for b in range(a + 1, self.n):
+                await self._connect_pair(a, b)
+
+    async def _connect_pair(self, a: int, b: int) -> None:
+        ca = Connection(b)  # a's handle, peer=b
+        cb = Connection(a)
+        pump_a = asyncio.ensure_future(self._pump(a, b, ca, cb))
+        pump_b = asyncio.ensure_future(self._pump(b, a, cb, ca))
+        self._links[(a, b)] = (ca, cb, pump_a, pump_b)
+        await self.node_connections[a].put(ca)
+        await self.node_connections[b].put(cb)
+
+    def _latency(self) -> float:
+        loop = asyncio.get_event_loop()
+        rng = getattr(loop, "rng", None)
+        lo, hi = self.LATENCY_RANGE
+        if rng is None:
+            import random
+
+            return random.uniform(lo, hi)
+        return rng.uniform(lo, hi)
+
+    async def _pump(self, src: int, dst: int, c_src: Connection, c_dst: Connection):
+        """Move messages src->dst with per-message latency."""
+        loop = asyncio.get_event_loop()
+        while not c_src.is_closed():
+            msg = await c_src.sender.get()
+
+            def deliver(m=msg):
+                if not c_dst.is_closed():
+                    try:
+                        c_dst.receiver.put_nowait(m)
+                    except asyncio.QueueFull:
+                        pass
+
+            loop.call_later(self._latency(), deliver)
+
+    # -- fault injection --
+
+    def _sever(self, a: int, b: int) -> None:
+        key = (min(a, b), max(a, b))
+        link = self._links.pop(key, None)
+        if link is None:
+            return
+        ca, cb, pump_a, pump_b = link
+        ca.close()
+        cb.close()
+        pump_a.cancel()
+        pump_b.cancel()
+        self._severed.add(key)
+
+    def partition(self, group_a: List[int], group_b: List[int]) -> None:
+        """Cut all links between the two groups.  Like a real partition over
+        TCP, the connections BREAK (peers see closure) — healing re-establishes
+        them, which re-runs the subscribe/catch-up path (net_sync.rs:753-780)."""
+        for a in group_a:
+            for b in group_b:
+                self._sever(a, b)
+
+    def isolate(self, node: int) -> None:
+        self.partition([node], [i for i in range(self.n) if i != node])
+
+    async def heal(self) -> None:
+        """Reconnect every severed pair (the reconnect-forever workers' job in
+        the real transport, network.rs:218-242)."""
+        severed, self._severed = self._severed, set()
+        for a, b in sorted(severed):
+            await self._connect_pair(a, b)
+
+    def close(self) -> None:
+        for ca, cb, pump_a, pump_b in self._links.values():
+            ca.close()
+            cb.close()
+            pump_a.cancel()
+            pump_b.cancel()

@@ -1,0 +1,86 @@
+"""Syncer: drives the Core on block arrival and leader timeouts, emits signals.
+
+Capability parity with ``mysticeti-core/src/syncer.rs``:
+
+* ``Signals`` {new_block_ready, new_round} (:24-52) — wake the dissemination
+  streams / reset the leader-timeout clock.
+* ``Syncer.add_blocks`` (:72-93) — feed core, signal round advance, maybe propose.
+* ``Syncer.force_new_block`` (:95-108) — leader-timeout path, bypasses the
+  ready gate.
+* ``try_new_block`` (:110-167) — ready-gate -> propose -> signal -> commit ->
+  observer -> persist commit + aggregator state.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from .commit_observer import CommitObserver
+from .core import Core
+from .types import AuthoritySet, BlockReference, RoundNumber, StatementBlock
+
+
+class SyncerSignals:
+    """Interface; the asyncio node wires these to Event/condition primitives."""
+
+    def new_block_ready(self) -> None:
+        pass
+
+    def new_round(self, round_: RoundNumber) -> None:
+        pass
+
+
+class Syncer:
+    def __init__(
+        self,
+        core: Core,
+        commit_period: int,
+        signals: SyncerSignals,
+        commit_observer: CommitObserver,
+        metrics=None,
+    ) -> None:
+        self.core = core
+        self.force_new_block_flag = False
+        self.commit_period = commit_period
+        self.signals = signals
+        self.commit_observer = commit_observer
+        self.metrics = metrics
+
+    def add_blocks(
+        self, blocks: Sequence[StatementBlock], connected_authorities: AuthoritySet
+    ) -> List[BlockReference]:
+        previous_round = self.core.current_round()
+        missing_references = self.core.add_blocks(blocks)
+        new_round = self.core.current_round()
+        if new_round > previous_round:
+            self.signals.new_round(new_round)
+        self.try_new_block(connected_authorities)
+        return missing_references
+
+    def force_new_block(
+        self, round_: RoundNumber, connected_authorities: AuthoritySet
+    ) -> bool:
+        if self.core.last_proposed() < round_:
+            if self.metrics is not None:
+                self.metrics.leader_timeout_total.inc()
+            self.force_new_block_flag = True
+            self.try_new_block(connected_authorities)
+            return True
+        return False
+
+    def try_new_block(self, connected_authorities: AuthoritySet) -> None:
+        if self.force_new_block_flag or self.core.ready_new_block(
+            self.commit_period, connected_authorities
+        ):
+            if self.core.try_new_block() is None:
+                return
+            self.signals.new_block_ready()
+            self.force_new_block_flag = False
+
+            if self.core.epoch_closed():
+                return  # no commits needed once the epoch is safe to close
+
+            newly_committed = self.core.try_commit()
+            committed_subdags = self.commit_observer.handle_commit(newly_committed)
+            self.core.handle_committed_subdag(
+                committed_subdags, self.commit_observer.aggregator_state()
+            )

@@ -1,0 +1,175 @@
+"""Block dissemination (push) and missing-block fetching (pull).
+
+Capability parity with ``mysticeti-core/src/synchronizer.rs``:
+
+* ``BlockDisseminator`` (:25-164) — per-peer push stream of own blocks, batched
+  (default 100), woken by the block-ready signal; answers explicit
+  ``RequestBlocks`` with chunks + ``BlockNotFound``.
+* ``BlockFetcher`` (:216-407) — every ``sample_precision`` asks the core for
+  missing references and requests them (≤ MAXIMUM_BLOCK_REQUEST) from a
+  latency-weighted random peer (:376-406).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence
+
+from .block_store import BlockStore
+from .config import SynchronizerParameters
+from .core_task import CoreTaskDispatcher
+from .network import (
+    BlockNotFound,
+    Blocks,
+    Connection,
+    RequestBlocks,
+    RequestBlocksResponse,
+)
+from .types import BlockReference, RoundNumber
+
+MAXIMUM_BLOCK_REQUEST = 50  # net_sync.rs:30
+DISSEMINATION_CHUNK = 10  # synchronizer.rs:74 send_blocks chunking
+
+
+class BlockDisseminator:
+    """Serves one peer connection (synchronizer.rs:25-164)."""
+
+    def __init__(
+        self,
+        connection: Connection,
+        block_store: BlockStore,
+        block_ready: asyncio.Event,
+        parameters: Optional[SynchronizerParameters] = None,
+        metrics=None,
+    ) -> None:
+        self.connection = connection
+        self.block_store = block_store
+        self.block_ready = block_ready
+        self.parameters = parameters or SynchronizerParameters()
+        self.metrics = metrics
+        self._stream_task: Optional[asyncio.Task] = None
+
+    def subscribe_own_from(self, from_round: RoundNumber) -> None:
+        """Peer asked for our blocks starting after ``from_round``."""
+        if self._stream_task is not None:
+            self._stream_task.cancel()
+        self._stream_task = asyncio.ensure_future(self._stream_own(from_round))
+
+    async def _stream_own(self, from_round: RoundNumber) -> None:
+        """Push loop (synchronizer.rs:131-164): batch, send, wait for new blocks."""
+        cursor = from_round
+        batch_size = self.parameters.batch_size
+        while not self.connection.is_closed():
+            blocks = self.block_store.get_own_blocks(cursor, batch_size)
+            if blocks:
+                cursor = max(b.round() for b in blocks)
+                await self.connection.send(
+                    Blocks(tuple(b.to_bytes() for b in blocks))
+                )
+            else:
+                waiter = asyncio.ensure_future(self.block_ready.wait())
+                try:
+                    await asyncio.wait_for(
+                        waiter, timeout=self.parameters.stream_interval_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+    async def send_requested(self, references: Sequence[BlockReference]) -> None:
+        """Answer an explicit RequestBlocks (synchronizer.rs:74-112)."""
+        found: List[bytes] = []
+        missing: List[BlockReference] = []
+        for ref in references[:MAXIMUM_BLOCK_REQUEST]:
+            block = self.block_store.get_block(ref)
+            if block is None:
+                missing.append(ref)
+            else:
+                found.append(block.to_bytes())
+        for i in range(0, len(found), DISSEMINATION_CHUNK):
+            await self.connection.send(
+                RequestBlocksResponse(tuple(found[i : i + DISSEMINATION_CHUNK]))
+            )
+        if missing:
+            await self.connection.send(BlockNotFound(tuple(missing)))
+
+    def stop(self) -> None:
+        if self._stream_task is not None:
+            self._stream_task.cancel()
+
+
+class BlockFetcher:
+    """Pull loop for missing causal history (synchronizer.rs:216-407)."""
+
+    def __init__(
+        self,
+        authority: int,
+        dispatcher: CoreTaskDispatcher,
+        connections: Dict[int, Connection],
+        parameters: Optional[SynchronizerParameters] = None,
+        metrics=None,
+    ) -> None:
+        self.authority = authority
+        self.dispatcher = dispatcher
+        self.connections = connections  # live view maintained by NetworkSyncer
+        self.parameters = parameters or SynchronizerParameters()
+        self.metrics = metrics
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> "BlockFetcher":
+        self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.parameters.sample_precision_s)
+            try:
+                missing = await self.dispatcher.get_missing()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                continue
+            to_request: List[BlockReference] = []
+            for authority_missing in missing:
+                to_request.extend(authority_missing)
+            if not to_request:
+                continue
+            if self.metrics is not None:
+                self.metrics.missing_blocks_total.inc(len(to_request))
+            for i in range(0, len(to_request), MAXIMUM_BLOCK_REQUEST):
+                chunk = to_request[i : i + MAXIMUM_BLOCK_REQUEST]
+                peer = self._sample_peer(exclude={self.authority})
+                if peer is None:
+                    break
+                await self.connections[peer].send(RequestBlocks(tuple(chunk)))
+
+    def _sample_peer(self, exclude) -> Optional[int]:
+        """Latency-weighted random choice (synchronizer.rs:376-406): weight is
+        inverse RTT; unmeasured peers get the median weight."""
+        import random as _random
+
+        loop = asyncio.get_event_loop()
+        rng = getattr(loop, "rng", _random)
+        candidates = [
+            (peer, conn)
+            for peer, conn in self.connections.items()
+            if peer not in exclude and not conn.is_closed()
+        ]
+        if not candidates:
+            return None
+        latencies = [c.latency() for _, c in candidates]
+        finite = sorted(l for l in latencies if l != float("inf"))
+        default = finite[len(finite) // 2] if finite else 1.0
+        weights = [
+            1.0 / max(1e-4, (l if l != float("inf") else default)) for l in latencies
+        ]
+        total = sum(weights)
+        point = rng.uniform(0, total)
+        acc = 0.0
+        for (peer, _), w in zip(candidates, weights):
+            acc += w
+            if point <= acc:
+                return peer
+        return candidates[-1][0]
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

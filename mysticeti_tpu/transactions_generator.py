@@ -1,0 +1,73 @@
+"""Open-loop benchmark transaction generator.
+
+Capability parity with ``mysticeti-core/src/transactions_generator.rs``:
+
+* seeded RNG, fixed transaction size (default 512 B), target tx/s (:29-45)
+* 100 ms ticks producing evenly-sized batches, submitted to the block handler
+  (:47-101)
+* each transaction is prefixed with an 8-byte submission timestamp + 8-byte
+  nonce; ``extract_timestamp`` recovers it for end-to-end latency metrics
+  (:103-108)
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import time
+from typing import Callable, List, Optional
+
+TRANSACTION_SIZE_DEFAULT = 512
+TICK_S = 0.1
+
+
+class TransactionGenerator:
+    def __init__(
+        self,
+        submit: Callable[[List[bytes]], None],
+        seed: int,
+        tps: int,
+        transaction_size: int = TRANSACTION_SIZE_DEFAULT,
+        initial_delay_s: float = 0.0,
+    ) -> None:
+        assert transaction_size >= 16, "needs room for timestamp + nonce"
+        self.submit = submit
+        self.rng = random.Random(seed)
+        self.tps = tps
+        self.transaction_size = transaction_size
+        self.initial_delay_s = initial_delay_s
+        self._task: Optional[asyncio.Task] = None
+
+    def make_batch(self, count: int) -> List[bytes]:
+        now = time.time()
+        ts = struct.pack("<d", now)
+        pad = b"\x00" * (self.transaction_size - 16)
+        return [
+            ts + struct.pack("<Q", self.rng.getrandbits(64)) + pad
+            for _ in range(count)
+        ]
+
+    @staticmethod
+    def extract_timestamp(transaction: bytes) -> float:
+        """First 8 bytes = float64 submission time (transactions_generator.rs:103-108)."""
+        if len(transaction) < 8:
+            return 0.0
+        return struct.unpack("<d", transaction[:8])[0]
+
+    def start(self) -> asyncio.Task:
+        self._task = asyncio.get_event_loop().create_task(self._run())
+        return self._task
+
+    async def _run(self) -> None:
+        if self.initial_delay_s:
+            await asyncio.sleep(self.initial_delay_s)
+        per_tick = max(1, int(self.tps * TICK_S))
+        while True:
+            started = time.monotonic()
+            self.submit(self.make_batch(per_tick))
+            elapsed = time.monotonic() - started
+            await asyncio.sleep(max(0.0, TICK_S - elapsed))
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,100 @@
+"""Deterministic virtual-time loop tests (simulator.rs:193-228 tier)."""
+import asyncio
+import time
+
+import pytest
+
+from mysticeti_tpu.runtime import simulated
+from mysticeti_tpu.runtime.simulated import DeterministicLoop, run_simulation
+
+
+def test_virtual_sleep_is_instant_and_exact():
+    async def main():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(3600.0)  # one virtual hour
+        return loop.time() - t0
+
+    wall0 = time.monotonic()
+    elapsed_virtual = run_simulation(main())
+    wall = time.monotonic() - wall0
+    assert abs(elapsed_virtual - 3600.0) < 1e-6
+    assert wall < 5.0  # no real waiting
+
+
+def test_timer_ordering_deterministic():
+    async def main():
+        loop = asyncio.get_running_loop()
+        events = []
+
+        async def ticker(name, period, count):
+            for _ in range(count):
+                await asyncio.sleep(period)
+                events.append((round(loop.time(), 6), name))
+
+        await asyncio.gather(ticker("a", 0.3, 5), ticker("b", 0.5, 3))
+        return events
+
+    first = run_simulation(main(), seed=1)
+    second = run_simulation(main(), seed=1)
+    assert first == second
+    assert first[0] == (0.3, "a")
+    assert (1.5, "b") in first
+
+
+def test_asyncio_primitives_work():
+    async def main():
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        ev = asyncio.Event()
+        results = []
+
+        async def producer():
+            for i in range(3):
+                await asyncio.sleep(0.1)
+                await q.put(i)
+            ev.set()
+
+        async def consumer():
+            while not (ev.is_set() and q.empty()):
+                try:
+                    item = await asyncio.wait_for(q.get(), timeout=0.05)
+                    results.append((round(loop.time(), 6), item))
+                except asyncio.TimeoutError:
+                    continue
+            return results
+
+        _, res = await asyncio.gather(producer(), consumer())
+        return res
+
+    res = run_simulation(main())
+    assert [item for _, item in res] == [0, 1, 2]
+    assert res[0][0] >= 0.1
+
+
+def test_seeded_rng_on_loop():
+    async def main():
+        loop = asyncio.get_running_loop()
+        return [loop.rng.randrange(1000) for _ in range(5)]
+
+    assert run_simulation(main(), seed=42) == run_simulation(main(), seed=42)
+    assert run_simulation(main(), seed=42) != run_simulation(main(), seed=43)
+
+
+def test_virtual_timeout():
+    async def main():
+        await asyncio.sleep(10**6)
+
+    with pytest.raises(asyncio.TimeoutError):
+        run_simulation(main(), timeout_s=100.0)
+
+
+def test_utc_time_offset():
+    async def main():
+        from mysticeti_tpu import runtime
+
+        t0 = runtime.timestamp_utc()
+        await asyncio.sleep(12.5)
+        return runtime.timestamp_utc() - t0
+
+    assert abs(run_simulation(main()) - 12.5) < 1e-6
