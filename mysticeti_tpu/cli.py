@@ -1,0 +1,187 @@
+"""Node binary: genesis, run, dry-run, testbed subcommands.
+
+Capability parity with ``mysticeti/src/main.rs``:
+
+* ``benchmark-genesis`` (:36-43,116-156) — emit committee.yaml, parameters.yaml
+  and per-authority private configs (key seed + storage dir).
+* ``run`` (:44-58,159-185) — start one validator from config files.
+* ``dry-run`` (:59-67,229-268) — single-command local validator: generates an
+  in-process benchmark config for N authorities and runs one of them.
+* ``testbed`` (:68-73,187-227) — N in-process validators on localhost.
+
+Plus this framework's switch: ``--verifier {accept,cpu,tpu}`` selects the
+signature backend (TPU = the batched JAX kernel).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+from typing import List, Optional
+
+import yaml
+
+from .committee import Authority, Committee, STAKE_WEIGHTED
+from .config import Identifier, Parameters, PrivateConfig
+from .crypto import Signer
+from .validator import Validator
+
+
+def _benchmark_parameters(ips: List[str]) -> Parameters:
+    return Parameters.new_for_benchmarks(ips)
+
+
+def benchmark_genesis(
+    ips: List[str], working_dir: str, node_parameters: Optional[Parameters] = None
+) -> None:
+    """main.rs:116-156."""
+    os.makedirs(working_dir, exist_ok=True)
+    committee_size = len(ips)
+    signers = Committee.benchmark_signers(committee_size)
+    committee = Committee(
+        [
+            Authority(1, s.public_key, hostname=ip)
+            for s, ip in zip(signers, ips)
+        ],
+        leader_election=STAKE_WEIGHTED,
+    )
+    committee.dump(os.path.join(working_dir, "committee.yaml"))
+    parameters = node_parameters or _benchmark_parameters(ips)
+    parameters.dump(os.path.join(working_dir, "parameters.yaml"))
+    for i in range(committee_size):
+        private_dir = os.path.join(working_dir, f"validator-{i}")
+        private = PrivateConfig.new_in_dir(i, private_dir)
+        with open(os.path.join(private_dir, "seed"), "wb") as f:
+            f.write(i.to_bytes(32, "little"))
+
+
+async def run_node(
+    authority: int,
+    committee_path: str,
+    parameters_path: str,
+    private_dir: str,
+    verifier: str = "accept",
+    tps: Optional[int] = None,
+) -> None:
+    """main.rs:159-185."""
+    committee = Committee.load(committee_path)
+    parameters = Parameters.load(parameters_path)
+    private = PrivateConfig.new_in_dir(authority, private_dir)
+    seed_path = os.path.join(private_dir, "seed")
+    with open(seed_path, "rb") as f:
+        signer = Signer.from_seed(f.read())
+    validator = await Validator.start_benchmarking(
+        authority,
+        committee,
+        parameters,
+        private,
+        signer=signer,
+        tps=tps,
+        verifier=verifier,
+    )
+    await validator.network_syncer.await_completion()
+
+
+async def testbed(committee_size: int, working_dir: str, duration_s: float,
+                  verifier: str = "accept") -> List:
+    """N in-process validators on localhost (main.rs:187-227)."""
+    ips = ["127.0.0.1"] * committee_size
+    benchmark_genesis(ips, working_dir)
+    committee = Committee.load(os.path.join(working_dir, "committee.yaml"))
+    parameters = Parameters.load(os.path.join(working_dir, "parameters.yaml"))
+    signers = Committee.benchmark_signers(committee_size)
+    validators = []
+    for i in range(committee_size):
+        private = PrivateConfig.new_in_dir(
+            i, os.path.join(working_dir, f"validator-{i}")
+        )
+        validators.append(
+            await Validator.start_benchmarking(
+                i,
+                committee,
+                parameters,
+                private,
+                signer=signers[i],
+                serve_metrics_endpoint=False,
+                verifier=verifier,
+            )
+        )
+    await asyncio.sleep(duration_s)
+    committed = [v.committed_leaders() for v in validators]
+    for v in validators:
+        await v.stop()
+    return committed
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="mysticeti-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("benchmark-genesis", help="emit benchmark configs")
+    g.add_argument("--ips", nargs="+", required=True)
+    g.add_argument("--working-directory", default="genesis")
+
+    r = sub.add_parser("run", help="run one validator")
+    r.add_argument("--authority", type=int, required=True)
+    r.add_argument("--committee-path", required=True)
+    r.add_argument("--parameters-path", required=True)
+    r.add_argument("--private-config-path", required=True)
+    r.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="accept")
+
+    d = sub.add_parser("dry-run", help="one validator of an N-node local setup")
+    d.add_argument("--committee-size", type=int, required=True)
+    d.add_argument("--authority", type=int, required=True)
+    d.add_argument("--working-directory", default="dryrun")
+    d.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="accept")
+
+    t = sub.add_parser("testbed", help="N in-process validators")
+    t.add_argument("--committee-size", type=int, required=True)
+    t.add_argument("--working-directory", default="testbed")
+    t.add_argument("--duration", type=float, default=30.0)
+    t.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="accept")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "benchmark-genesis":
+        benchmark_genesis(args.ips, args.working_directory)
+        print(f"genesis written to {args.working_directory}")
+        return 0
+    if args.command == "run":
+        asyncio.run(
+            run_node(
+                args.authority,
+                args.committee_path,
+                args.parameters_path,
+                args.private_config_path,
+                verifier=args.verifier,
+            )
+        )
+        return 0
+    if args.command == "dry-run":
+        wd = args.working_directory
+        ips = ["127.0.0.1"] * args.committee_size
+        benchmark_genesis(ips, wd)
+        asyncio.run(
+            run_node(
+                args.authority,
+                os.path.join(wd, "committee.yaml"),
+                os.path.join(wd, "parameters.yaml"),
+                os.path.join(wd, f"validator-{args.authority}"),
+                verifier=args.verifier,
+            )
+        )
+        return 0
+    if args.command == "testbed":
+        committed = asyncio.run(
+            testbed(args.committee_size, args.working_directory, args.duration,
+                    args.verifier)
+        )
+        for i, seq in enumerate(committed):
+            print(f"validator {i}: {len(seq)} committed leaders")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,50 @@ class Committee:
     def benchmark_signers(size: int) -> List[crypto.Signer]:
         return [crypto.Signer.from_seed(i.to_bytes(32, "little")) for i in range(size)]
 
+    # -- YAML round-trip (committee.rs:34 committee.yaml via Print trait) --
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "leader_election": self.leader_election,
+            "authorities": [
+                {
+                    "stake": a.stake,
+                    "public_key": a.public_key.bytes.hex(),
+                    "hostname": a.hostname,
+                }
+                for a in self.authorities
+            ],
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Committee":
+        return cls(
+            [
+                Authority(
+                    a["stake"],
+                    crypto.PublicKey(bytes.fromhex(a["public_key"])),
+                    a.get("hostname", ""),
+                )
+                for a in raw["authorities"]
+            ],
+            epoch=raw.get("epoch", 0),
+            leader_election=raw.get("leader_election", STAKE_WEIGHTED),
+        )
+
+    def dump(self, path: str) -> None:
+        import yaml
+
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+    @classmethod
+    def load(cls, path: str) -> "Committee":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
     # -- thresholds --
 
     def validity_threshold(self) -> Stake:

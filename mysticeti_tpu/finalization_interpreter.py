@@ -1,0 +1,107 @@
+"""Offline whole-DAG re-interpretation: the fast-path safety oracle.
+
+Capability parity with ``mysticeti-core/src/finalization_interpreter.rs``
+(:13-148): recompute, from the stored DAG alone, which transactions are
+finalized (certified by a quorum of certifying blocks) and which blocks certify
+them.  Used by the simulation safety test to cross-check the online
+TransactionAggregator/commit pipeline against an independent implementation.
+
+Semantics: a block votes for a transaction if it shares it, votes for it
+explicitly, or (transitively) includes a block that voted; a block whose
+accumulated voter stake reaches quorum *certifies* the transaction (unless the
+block carries the epoch-change marker); a transaction is *finalized* once
+certifying blocks from a quorum of distinct authors exist.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .block_store import BlockStore
+from .committee import Committee, QUORUM, StakeAggregator
+from .types import (
+    BlockReference,
+    Share,
+    StatementBlock,
+    TransactionLocator,
+    Vote,
+    VoteRange,
+)
+
+
+class FinalizationInterpreter:
+    def __init__(self, block_store: BlockStore, committee: Committee) -> None:
+        self.block_store = block_store
+        self.committee = committee
+        # per-block: tx -> voter-stake aggregator
+        self.transaction_aggregator: Dict[
+            BlockReference, Dict[TransactionLocator, StakeAggregator]
+        ] = {}
+        self.certificate_aggregator: Dict[TransactionLocator, StakeAggregator] = {}
+        self.transaction_certificates: Dict[
+            TransactionLocator, Set[BlockReference]
+        ] = {}
+        self.finalized_transactions: Set[TransactionLocator] = set()
+
+    def finalized_tx_certifying_blocks(
+        self,
+    ) -> List[Tuple[TransactionLocator, Set[BlockReference]]]:
+        for round_ in range(self.block_store.highest_round() + 1):
+            for block in self.block_store.get_blocks_by_round(round_):
+                self._process(block)
+        return [
+            (tx, blocks)
+            for tx, blocks in self.transaction_certificates.items()
+            if tx in self.finalized_transactions
+        ]
+
+    def _process(self, block: StatementBlock) -> None:
+        if block.reference in self.transaction_aggregator:
+            return
+        self.transaction_aggregator[block.reference] = {}
+
+        for offset, statement in enumerate(block.statements):
+            if isinstance(statement, Vote):
+                if statement.accept:
+                    self._vote(block, statement.locator, block.author())
+            elif isinstance(statement, VoteRange):
+                for locator in statement.range.locators():
+                    self._vote(block, locator, block.author())
+            elif isinstance(statement, Share):
+                self._vote(
+                    block,
+                    TransactionLocator(block.reference, offset),
+                    block.author(),
+                )
+
+        for parent_ref in block.includes:
+            parent = self.block_store.get_block(parent_ref)
+            assert parent is not None, "whole DAG must be stored"
+            self._process(parent)
+            # Inherit every vote visible through the parent.
+            parent_aggregator = self.transaction_aggregator[parent_ref]
+            self.transaction_aggregator[parent_ref] = {}
+            for tx, agg in parent_aggregator.items():
+                for voter in agg.voters():
+                    self._vote(block, tx, voter)
+            self.transaction_aggregator[parent_ref] = parent_aggregator
+
+    def _vote(
+        self,
+        block: StatementBlock,
+        transaction: TransactionLocator,
+        tx_voter: int,
+    ) -> None:
+        aggs = self.transaction_aggregator[block.reference]
+        agg = aggs.get(transaction)
+        if agg is None:
+            agg = aggs[transaction] = StakeAggregator(QUORUM)
+        if agg.add(tx_voter, self.committee) and not block.epoch_changed():
+            # ``block`` certifies this transaction.
+            self.transaction_certificates.setdefault(transaction, set()).add(
+                block.reference
+            )
+            cert = self.certificate_aggregator.get(transaction)
+            if cert is None:
+                cert = self.certificate_aggregator[transaction] = StakeAggregator(QUORUM)
+            if cert.add(block.author(), self.committee):
+                self.finalized_transactions.add(transaction)

@@ -1,0 +1,249 @@
+"""Observability: prometheus series, exact-percentile histograms, busy timers.
+
+Capability parity with ``mysticeti-core/src/metrics.rs`` + ``stat.rs`` +
+``prometheus.rs``:
+
+* the full metric inventory (metrics.rs:36-87), including the benchmark-defining
+  series ``benchmark_duration`` / ``latency_s`` / ``latency_squared_s``
+  (metrics.rs:31-33) that the orchestrator's measurement scraper consumes;
+* ``PreciseHistogram`` — exact p50/90/99 percentiles over a bounded sample
+  buffer, surfaced as gauges by a periodic ``MetricReporter`` task
+  (stat.rs:8-100, metrics.rs:534-601);
+* utilization timers — context managers accumulating busy-microseconds per
+  labeled code section, the reference's poor-man's profiler (metrics.rs:615-666);
+* an HTTP ``/metrics`` endpoint (prometheus.rs:31-49) served by asyncio.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+LATENCY_SEC_BUCKETS = [
+    0.1, 0.25, 0.5, 0.75, 1.0, 1.25, 1.5, 1.75, 2.0, 2.5, 5.0, 10.0, 20.0,
+    30.0, 60.0, 90.0,
+]
+
+BENCHMARK_DURATION = "benchmark_duration"
+LATENCY_S = "latency_s"
+LATENCY_SQUARED_S = "latency_squared_s"
+
+
+class PreciseHistogram:
+    """Exact-percentile histogram over a bounded sample window (stat.rs:8-100)."""
+
+    __slots__ = ("samples", "count", "sum", "max_samples")
+
+    def __init__(self, max_samples: int = 100_000) -> None:
+        self.samples: List[float] = []
+        self.count = 0
+        self.sum = 0.0
+        self.max_samples = max_samples
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        if len(self.samples) < self.max_samples:
+            self.samples.append(value)
+
+    def pcts(self, pcts: Sequence[int]) -> Optional[Dict[int, float]]:
+        if not self.samples:
+            return None
+        ordered = sorted(self.samples)
+        out = {}
+        for pct in pcts:
+            idx = min(len(ordered) - 1, int(len(ordered) * pct / 100))
+            out[pct] = ordered[idx]
+        return out
+
+    def avg(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def clear(self) -> None:
+        self.samples.clear()
+
+
+class Metrics:
+    """Registers every series on a fresh registry (metrics.rs:121-424)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+
+        def counter(name, doc, labels=()):
+            return Counter(name, doc, labelnames=labels, registry=r)
+
+        def gauge(name, doc, labels=()):
+            return Gauge(name, doc, labelnames=labels, registry=r)
+
+        def histogram(name, doc, labels=(), buckets=LATENCY_SEC_BUCKETS):
+            return Histogram(name, doc, labelnames=labels, buckets=buckets, registry=r)
+
+        # Benchmark-defining series (metrics.rs:31-33).
+        self.benchmark_duration = counter(BENCHMARK_DURATION, "benchmark duration, s")
+        self.latency_s = histogram(
+            LATENCY_S, "end-to-end tx latency", labels=("workload",)
+        )
+        self.latency_squared_s = counter(
+            LATENCY_SQUARED_S, "sum of squared latencies", labels=("workload",)
+        )
+
+        # Consensus progress.
+        self.committed_leaders_total = counter(
+            "committed_leaders_total", "decided leaders", labels=("authority", "status")
+        )
+        self.leader_timeout_total = counter("leader_timeout_total", "leader timeouts")
+        self.inter_block_latency_s = histogram(
+            "inter_block_latency_s", "inter-block latency", labels=("workload",)
+        )
+        self.threshold_clock_round = gauge("threshold_clock_round", "current round")
+        self.commit_round = gauge("commit_round", "last committed round")
+        self.ready_new_block = counter(
+            "ready_new_block", "proposal readiness reasons", labels=("reason",)
+        )
+
+        # Block store.
+        self.block_store_unloaded_blocks = counter(
+            "block_store_unloaded_blocks", "cache evictions"
+        )
+        self.block_store_loaded_blocks = counter(
+            "block_store_loaded_blocks", "wal reloads"
+        )
+        self.block_store_entries = counter("block_store_entries", "stored blocks")
+        self.wal_mappings = gauge("wal_mappings", "live mmap windows")
+
+        # Handlers.
+        self.block_handler_pending_certificates = gauge(
+            "block_handler_pending_certificates", "pending fast-path certs"
+        )
+        self.commit_handler_pending_certificates = gauge(
+            "commit_handler_pending_certificates", "pending commit certs"
+        )
+
+        # Sync.
+        self.missing_blocks_total = counter("missing_blocks_total", "missing refs seen")
+        self.blocks_suspended = counter("blocks_suspended", "parked blocks")
+        self.block_sync_requests_sent = counter(
+            "block_sync_requests_sent", "sync requests", labels=("peer",)
+        )
+        self.block_sync_requests_failed = counter(
+            "block_sync_requests_failed", "refs peers did not have"
+        )
+        self.connected_nodes = gauge("connected_nodes", "live peer connections")
+        self.connection_latency = histogram(
+            "connection_latency", "peer rtt", labels=("peer",),
+            buckets=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0],
+        )
+
+        # TPU verifier.
+        self.verified_signatures_total = counter(
+            "verified_signatures_total", "batched signature verifications",
+            labels=("backend", "outcome"),
+        )
+        self.verify_batch_size = histogram(
+            "verify_batch_size", "signature batch sizes",
+            buckets=[1, 8, 32, 64, 128, 256, 512, 1024, 4096],
+        )
+
+        # Utilization timers (metrics.rs:615-666).
+        self.utilization_timer_us = counter(
+            "utilization_timer", "busy time per section, us", labels=("proc",)
+        )
+
+        # Exact-percentile channels (stat.rs), reported as gauges.
+        self._precise: Dict[str, PreciseHistogram] = {}
+        self._pct_gauge = gauge(
+            "histogram_pct", "exact percentiles", labels=("name", "pct")
+        )
+        for name in (
+            "transaction_certified_latency",
+            "certificate_committed_latency",
+            "transaction_committed_latency",
+            "proposed_block_size_bytes",
+            "proposed_block_transaction_count",
+            "proposed_block_vote_count",
+            "blocks_per_commit_count",
+            "sub_dags_per_commit_count",
+            "block_commit_latency",
+        ):
+            self._precise[name] = PreciseHistogram()
+            setattr(self, name, self._precise[name])
+        self.quorum_receive_latency = PreciseHistogram()
+        self._precise["quorum_receive_latency"] = self.quorum_receive_latency
+
+    @contextmanager
+    def utilization_timer(self, proc: str):
+        """Drop-guard busy counter (metrics.rs:615-666)."""
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self.utilization_timer_us.labels(proc).inc(
+                int((time.monotonic() - start) * 1e6)
+            )
+
+    def report_precise(self) -> None:
+        """One reporter sweep: publish exact percentiles (metrics.rs:534-601)."""
+        for name, hist in self._precise.items():
+            pcts = hist.pcts((50, 90, 99))
+            if pcts is None:
+                continue
+            for pct, value in pcts.items():
+                self._pct_gauge.labels(name, str(pct)).set(value)
+
+    def expose(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class MetricReporter:
+    """Periodic exact-percentile publisher (metrics.rs:534-601, 60 s cadence)."""
+
+    def __init__(self, metrics: Metrics, interval_s: float = 60.0) -> None:
+        self.metrics = metrics
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> "MetricReporter":
+        self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            self.metrics.report_precise()
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+
+async def serve_metrics(metrics: Metrics, host: str, port: int):
+    """Minimal asyncio HTTP /metrics endpoint (prometheus.rs:31-49)."""
+
+    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            await reader.readline()  # request line; drain headers lazily
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            body = metrics.expose()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host=host, port=port)

@@ -1,0 +1,235 @@
+"""Node assembly: storage, handlers, network, syncer — the whole validator.
+
+Capability parity with ``mysticeti-core/src/validator.rs``:
+
+* ``Validator.start_benchmarking`` (:78-163) — benchmark fast-path handler +
+  open-loop generator + TestCommitObserver + metrics endpoint.
+* ``Validator.start_production`` (:165-212) — SimpleBlockHandler (application
+  submits raw transactions, acked on proposal) + SimpleCommitObserver
+  (sub-dags to a consumer queue with replay above last_sent_height).
+* ``init_storage`` (:334-352) — WAL + BlockStore recovery.
+* ``CommitConsumer`` (:50-66) — the application-facing commit stream handle.
+
+TPU addition (the point of this framework): ``verifier=`` selects the signature
+backend — "tpu" routes block verification through the batched JAX kernel
+(block_validator.py), "cpu" uses the serial OpenSSL oracle (reference
+behavior), "accept" skips signature checks (the reference's default
+AcceptAllBlockVerifier wiring, validator.rs:137).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import List, Optional, Tuple
+
+from .block_handler import BenchmarkFastPathBlockHandler, SimpleBlockHandler
+from .block_store import BlockStore
+from .block_validator import (
+    AcceptAllBlockVerifier,
+    BatchedSignatureVerifier,
+    CpuSignatureVerifier,
+    TpuSignatureVerifier,
+)
+from .commit_observer import SimpleCommitObserver, TestCommitObserver
+from .committee import Committee
+from .config import Parameters, PrivateConfig
+from .core import Core, CoreOptions
+from .crypto import Signer
+from .metrics import MetricReporter, Metrics, serve_metrics
+from .net_sync import NetworkSyncer
+from .network import TcpNetwork
+from .transactions_generator import TransactionGenerator
+from .wal import walf
+
+
+class CommitConsumer:
+    """Application handle for consuming committed sub-dags (validator.rs:50-66)."""
+
+    def __init__(self, last_sent_height: int = 0) -> None:
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.last_sent_height = last_sent_height
+
+    def send(self, sub_dag) -> None:
+        self.queue.put_nowait(sub_dag)
+
+
+def _make_verifier(kind: str, committee: Committee):
+    if kind == "tpu":
+        return BatchedSignatureVerifier(committee, TpuSignatureVerifier())
+    if kind == "cpu":
+        return BatchedSignatureVerifier(committee, CpuSignatureVerifier())
+    return AcceptAllBlockVerifier()
+
+
+class Validator:
+    def __init__(self) -> None:
+        self.network_syncer: Optional[NetworkSyncer] = None
+        self.metrics: Optional[Metrics] = None
+        self.reporter: Optional[MetricReporter] = None
+        self.generator: Optional[TransactionGenerator] = None
+        self._metrics_server = None
+        self.core: Optional[Core] = None
+
+    # -- storage (validator.rs:334-352) --
+
+    @staticmethod
+    def init_storage(authority: int, committee: Committee, private: PrivateConfig):
+        wal_writer, wal_reader = walf(private.wal())
+        return BlockStore.open(authority, wal_reader, wal_writer, committee) + (
+            wal_writer,
+        )
+
+    # -- benchmarking node (validator.rs:78-163) --
+
+    @classmethod
+    async def start_benchmarking(
+        cls,
+        authority: int,
+        committee: Committee,
+        parameters: Parameters,
+        private: PrivateConfig,
+        signer: Optional[Signer] = None,
+        tps: Optional[int] = None,
+        transaction_size: int = 512,
+        verifier: str = "accept",
+        serve_metrics_endpoint: bool = True,
+        network: Optional[object] = None,
+    ) -> "Validator":
+        v = cls()
+        v.metrics = Metrics()
+        (recovered, observer_recovered, wal_writer) = cls.init_storage(
+            authority, committee, private
+        )
+        handler = BenchmarkFastPathBlockHandler(
+            committee,
+            authority,
+            certified_log_path=private.certified_transactions_log(),
+            block_store=recovered.block_store,
+            metrics=v.metrics,
+        )
+        core = Core(
+            block_handler=handler,
+            authority=authority,
+            committee=committee,
+            parameters=parameters,
+            recovered=recovered,
+            wal_writer=wal_writer,
+            options=CoreOptions.production(),
+            signer=signer,
+            metrics=v.metrics,
+        )
+        v.core = core
+        observer = TestCommitObserver(
+            core.block_store,
+            committee,
+            transaction_time=handler.transaction_time,
+            metrics=v.metrics,
+            recovered_state=observer_recovered,
+        )
+        tps = tps if tps is not None else int(os.environ.get("TPS", "10"))
+        v.generator = TransactionGenerator(
+            submit=handler.submit,
+            seed=authority,
+            tps=tps,
+            transaction_size=transaction_size,
+            initial_delay_s=float(os.environ.get("INITIAL_DELAY", "2")),
+        )
+        if network is None:
+            network = await TcpNetwork.start(
+                authority,
+                parameters.all_network_addresses(),
+                metrics=v.metrics,
+                max_latency_s=parameters.network_connection_max_latency_s,
+            )
+        v.network_syncer = NetworkSyncer(
+            core,
+            observer,
+            network,
+            parameters=parameters,
+            block_verifier=_make_verifier(verifier, committee),
+            metrics=v.metrics,
+            start_wal_sync_thread=True,
+        )
+        await v.network_syncer.start()
+        v.generator.start()
+        v.reporter = MetricReporter(v.metrics).start()
+        if serve_metrics_endpoint and parameters.identifiers:
+            host, port = parameters.metrics_address(authority)
+            v._metrics_server = await serve_metrics(v.metrics, "0.0.0.0", port)
+        return v
+
+    # -- production node (validator.rs:165-212) --
+
+    @classmethod
+    async def start_production(
+        cls,
+        authority: int,
+        committee: Committee,
+        parameters: Parameters,
+        private: PrivateConfig,
+        signer: Optional[Signer] = None,
+        commit_consumer: Optional[CommitConsumer] = None,
+        verifier: str = "tpu",
+        network: Optional[object] = None,
+    ) -> Tuple["Validator", SimpleBlockHandler, CommitConsumer]:
+        v = cls()
+        v.metrics = Metrics()
+        (recovered, observer_recovered, wal_writer) = cls.init_storage(
+            authority, committee, private
+        )
+        handler = SimpleBlockHandler()
+        core = Core(
+            block_handler=handler,
+            authority=authority,
+            committee=committee,
+            parameters=parameters,
+            recovered=recovered,
+            wal_writer=wal_writer,
+            options=CoreOptions.production(),
+            signer=signer,
+            metrics=v.metrics,
+        )
+        v.core = core
+        consumer = commit_consumer or CommitConsumer()
+        observer = SimpleCommitObserver(
+            core.block_store,
+            consumer.send,
+            last_sent_height=consumer.last_sent_height,
+            recovered_state=observer_recovered,
+            metrics=v.metrics,
+        )
+        if network is None:
+            network = await TcpNetwork.start(
+                authority,
+                parameters.all_network_addresses(),
+                metrics=v.metrics,
+                max_latency_s=parameters.network_connection_max_latency_s,
+            )
+        v.network_syncer = NetworkSyncer(
+            core,
+            observer,
+            network,
+            parameters=parameters,
+            block_verifier=_make_verifier(verifier, committee),
+            metrics=v.metrics,
+            start_wal_sync_thread=True,
+        )
+        await v.network_syncer.start()
+        v.reporter = MetricReporter(v.metrics).start()
+        return v, handler, consumer
+
+    async def stop(self) -> None:
+        if self.generator is not None:
+            self.generator.stop()
+        if self.reporter is not None:
+            self.reporter.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+        if self.network_syncer is not None:
+            await self.network_syncer.stop()
+        if self.core is not None:
+            self.core.wal_writer.close()
+
+    def committed_leaders(self) -> List:
+        observer = self.network_syncer.syncer.commit_observer
+        return list(getattr(observer, "committed_leaders", []))

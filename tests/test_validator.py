@@ -1,0 +1,155 @@
+"""Whole-node smoke tests over real localhost TCP (validator.rs:355-596 tier).
+
+These run on the REAL asyncio loop (not the simulator): they exercise actual
+sockets, frames, reconnects and the wal-sync thread.
+"""
+import asyncio
+import os
+import socket
+
+import pytest
+
+from mysticeti_tpu.cli import benchmark_genesis
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.config import Identifier, Parameters, PrivateConfig
+from mysticeti_tpu.validator import Validator
+
+
+def _free_ports(n):
+    socks = []
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _setup(tmp_path, n):
+    ports = _free_ports(2 * n)
+    identifiers = [
+        Identifier("127.0.0.1", ports[2 * i], ports[2 * i + 1]) for i in range(n)
+    ]
+    parameters = Parameters(identifiers=identifiers, leader_timeout_s=0.5)
+    signers = Committee.benchmark_signers(n)
+    from mysticeti_tpu.committee import Authority
+
+    committee = Committee([Authority(1, s.public_key) for s in signers])
+    privates = [
+        PrivateConfig.new_in_dir(i, str(tmp_path / f"v{i}")) for i in range(n)
+    ]
+    return committee, parameters, signers, privates
+
+
+async def _start_all(committee, parameters, signers, privates, n, verifier="accept"):
+    return [
+        await Validator.start_benchmarking(
+            i,
+            committee,
+            parameters,
+            privates[i],
+            signer=signers[i],
+            tps=20,
+            serve_metrics_endpoint=(i == 0),
+            verifier=verifier,
+        )
+        for i in range(n)
+    ]
+
+
+async def _wait_commits(validators, minimum, timeout_s):
+    async def poll():
+        while True:
+            if all(len(v.committed_leaders()) >= minimum for v in validators):
+                return
+            await asyncio.sleep(0.2)
+
+    await asyncio.wait_for(poll(), timeout=timeout_s)
+
+
+def test_validator_commit(tmp_path):
+    """4 validators over localhost TCP commit leaders (validator_commit)."""
+
+    async def main():
+        committee, parameters, signers, privates = _setup(tmp_path, 4)
+        validators = await _start_all(committee, parameters, signers, privates, 4)
+        try:
+            await _wait_commits(validators, minimum=2, timeout_s=60)
+        finally:
+            for v in validators:
+                await v.stop()
+
+    asyncio.run(main())
+
+
+def test_validator_sync_late_boot(tmp_path):
+    """A late-booting node catches up through subscribe/sync (validator_sync)."""
+
+    async def main():
+        committee, parameters, signers, privates = _setup(tmp_path, 4)
+        validators = await _start_all(committee, parameters, signers, privates, 3)
+        try:
+            await _wait_commits(validators, minimum=2, timeout_s=60)
+            late = await Validator.start_benchmarking(
+                3, committee, parameters, privates[3], signer=signers[3],
+                tps=20, serve_metrics_endpoint=False,
+            )
+            validators.append(late)
+            await _wait_commits([late], minimum=1, timeout_s=60)
+        finally:
+            for v in validators:
+                await v.stop()
+
+    asyncio.run(main())
+
+
+def test_validator_crash_faults(tmp_path):
+    """3 of 4 validators keep committing (validator_crash_faults)."""
+
+    async def main():
+        committee, parameters, signers, privates = _setup(tmp_path, 4)
+        validators = await _start_all(committee, parameters, signers, privates, 3)
+        try:
+            await _wait_commits(validators, minimum=2, timeout_s=90)
+        finally:
+            for v in validators:
+                await v.stop()
+
+    asyncio.run(main())
+
+
+def test_validator_metrics_endpoint(tmp_path):
+    """The /metrics endpoint serves the benchmark-defining series."""
+
+    async def main():
+        committee, parameters, signers, privates = _setup(tmp_path, 4)
+        validators = await _start_all(committee, parameters, signers, privates, 4)
+        try:
+            await _wait_commits(validators, minimum=1, timeout_s=60)
+            host, port = parameters.metrics_address(0)
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(-1), timeout=10)
+            writer.close()
+            assert b"committed_leaders_total" in data
+            assert b"benchmark_duration" in data
+        finally:
+            for v in validators:
+                await v.stop()
+
+    asyncio.run(main())
+
+
+def test_benchmark_genesis_roundtrip(tmp_path):
+    wd = str(tmp_path / "genesis")
+    benchmark_genesis(["10.0.0.1", "10.0.0.2", "10.0.0.3"], wd)
+    committee = Committee.load(os.path.join(wd, "committee.yaml"))
+    parameters = Parameters.load(os.path.join(wd, "parameters.yaml"))
+    assert len(committee) == 3
+    assert len(parameters.identifiers) == 3
+    assert parameters.identifiers[1].hostname == "10.0.0.2"
+    assert os.path.exists(os.path.join(wd, "validator-0", "seed"))
