@@ -145,29 +145,113 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndar
     return point, ok
 
 
-def _double_scalar_mul(
-    s_bits: jnp.ndarray, k_bits: jnp.ndarray, neg_a: Point
-) -> Point:
-    """[s]B + [k]negA via interleaved MSB-first double-and-add under lax.scan.
+# ---------------------------------------------------------------------------
+# Windowed double-scalar multiplication
+# ---------------------------------------------------------------------------
+#
+# [s]B uses a positional comb table precomputed ONCE on the host with python
+# ints (B is a protocol constant): T_B[w][v] = v * 16^w * B.  [s]B is then just
+# 64 table additions — zero doublings.  [k]A runs a 4-bit windowed ladder with
+# a 16-entry per-item table (15 vmapped adds to build), i.e. 256 doublings +
+# 64 adds instead of 256 doublings + ~128 conditional adds.  Verification is
+# not secret-dependent, so data-dependent *gathers* are fine (no constant-time
+# requirement); shapes remain static.
 
-    ``s_bits``/``k_bits``: (..., 256) int32 0/1, index 0 = MSB.  Constant trip
-    count and branch-free selects keep the compiled graph static.
+_WINDOWS = 64  # 4-bit windows covering 256 bits
+
+
+def _affine_add(p, q):
+    """Host-side python-int Edwards addition (for table generation only)."""
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    den1 = pow(1 + _D * x1 * x2 * y1 * y2, P - 2, P)
+    den2 = pow(1 - _D * x1 * x2 * y1 * y2, P - 2, P)
+    return ((x1 * y2 + x2 * y1) * den1 % P, (y1 * y2 + x1 * x2) * den2 % P)
+
+
+def _build_base_comb() -> np.ndarray:
+    """(64, 16, 4, 20) int32: extended-coordinate entries of v*16^w*B."""
+    table = np.zeros((_WINDOWS, 16, 4, F.NLIMBS), np.int32)
+    step = (_BX, _BY)  # 16^w * B
+    for w in range(_WINDOWS):
+        entry = None  # v * step
+        for v in range(16):
+            if entry is None:
+                x, y = 0, 1
+            else:
+                x, y = entry
+            table[w, v, 0] = F.int_to_limbs(x)
+            table[w, v, 1] = F.int_to_limbs(y)
+            table[w, v, 2] = F.int_to_limbs(1)
+            table[w, v, 3] = F.int_to_limbs(x * y % P)
+            entry = _affine_add(entry, step)
+        for _ in range(4):
+            step = _affine_add(step, step)
+    return table
+
+
+_B_COMB = jnp.asarray(_build_base_comb())
+
+
+def _gather_point(table: Point, idx: jnp.ndarray) -> Point:
+    """Select per-item entries: table coords (..., 16, 20), idx (...,).
+
+    Implemented as a one-hot masked sum, not a gather — dynamic gathers
+    serialize on the TPU VPU while the 16 multiply-adds stay lane-parallel.
     """
-    acc = _identity_like(neg_a[0])
-    b_point = tuple(jnp.broadcast_to(c, neg_a[0].shape) for c in _B_POINT)
+    onehot = (idx[..., None] == jnp.arange(16, dtype=jnp.int32)).astype(jnp.int32)
+    return tuple(
+        jnp.sum(onehot[..., :, None] * c, axis=-2) for c in table
+    )
 
-    def step(acc: Point, bits):
-        s_bit, k_bit = bits
-        acc = point_double(acc)
-        acc = _select(s_bit == 1, point_add(acc, b_point), acc)
-        acc = _select(k_bit == 1, point_add(acc, neg_a), acc)
+
+def _double_scalar_mul(
+    s_windows: jnp.ndarray, k_windows: jnp.ndarray, neg_a: Point
+) -> Point:
+    """[s]B + [k]negA.
+
+    ``s_windows``: (..., 64) int32 in 0..15, index 0 = LEAST significant window
+    (positional, matches the comb table).  ``k_windows``: same layout; the
+    ladder consumes them most-significant first.
+    """
+    # --- [k]negA: per-item 16-entry table, then 4-bit ladder ---
+    identity = _identity_like(neg_a[0])
+    tab = [identity, neg_a]
+    for v in range(2, 16):
+        tab.append(point_add(tab[v - 1], neg_a))
+    # (..., 16, 20) per coordinate.
+    tab_a: Point = tuple(
+        jnp.stack([t[c] for t in tab], axis=-2) for c in range(4)
+    )
+
+    def ladder_step(acc: Point, kw):
+        for _ in range(4):
+            acc = point_double(acc)
+        acc = point_add(acc, _gather_point(tab_a, kw))
         return acc, None
 
-    # scan over the bit axis: move it to the front.
-    sb = jnp.moveaxis(s_bits, -1, 0)
-    kb = jnp.moveaxis(k_bits, -1, 0)
-    acc, _ = jax.lax.scan(step, acc, (sb, kb))
-    return acc
+    kw_msb_first = jnp.moveaxis(k_windows[..., ::-1], -1, 0)  # scan axis front
+    acc, _ = jax.lax.scan(ladder_step, identity, kw_msb_first)
+
+    # --- [s]B: 64 comb-table additions, no doublings ---
+    def comb_step(acc: Point, inputs):
+        entries, sw = inputs  # entries: (16, 4, 20) const slice; sw: (...,)
+        table: Point = tuple(
+            jnp.broadcast_to(
+                entries[:, c, :], (*sw.shape, 16, F.NLIMBS)
+            )
+            for c in range(4)
+        )
+        return point_add(acc, _gather_point(table, sw)), None
+
+    sw = jnp.moveaxis(s_windows, -1, 0)
+    acc_b, _ = jax.lax.scan(comb_step, identity, (_B_COMB, sw))
+
+    return point_add(acc, acc_b)
 
 
 def verify_impl(
@@ -175,14 +259,14 @@ def verify_impl(
     a_sign: jnp.ndarray,  # (B,)
     r_y: jnp.ndarray,  # (B, 20) signature R y limbs (raw, unvalidated)
     r_sign: jnp.ndarray,  # (B,)
-    s_bits: jnp.ndarray,  # (B, 256)
-    k_bits: jnp.ndarray,  # (B, 256)
+    s_windows: jnp.ndarray,  # (B, 64) 4-bit windows of s, LSB window first
+    k_windows: jnp.ndarray,  # (B, 64) 4-bit windows of k, LSB window first
     host_ok: jnp.ndarray,  # (B,) host-side checks (s < L, canonical A, ...)
 ) -> jnp.ndarray:
     """Batched device verification; returns (B,) bool."""
     neg_a, decompress_ok = jax.vmap(decompress)(a_y, a_sign)
     neg_a = point_neg(neg_a)
-    res = _double_scalar_mul(s_bits, k_bits, neg_a)
+    res = _double_scalar_mul(s_windows, k_windows, neg_a)
     x, y, z, _ = res
     zinv = F.invert(z)
     x_aff = F.mul(x, zinv)
@@ -201,8 +285,8 @@ verify_kernel = jax.jit(verify_impl)
 # ---------------------------------------------------------------------------
 
 
-def _bits_msb_first(x: int) -> np.ndarray:
-    return np.array([(x >> (255 - i)) & 1 for i in range(256)], dtype=np.int32)
+def _windows_lsb_first(x: int) -> np.ndarray:
+    return np.array([(x >> (4 * w)) & 15 for w in range(_WINDOWS)], dtype=np.int32)
 
 
 def _ylimbs_and_sign(data32: bytes) -> Tuple[np.ndarray, int, int]:
@@ -229,8 +313,8 @@ def pack_batch(
     a_sign = np.zeros(n, np.int32)
     r_y = np.zeros((n, F.NLIMBS), np.int32)
     r_sign = np.zeros(n, np.int32)
-    s_bits = np.zeros((n, 256), np.int32)
-    k_bits = np.zeros((n, 256), np.int32)
+    s_bits = np.zeros((n, _WINDOWS), np.int32)
+    k_bits = np.zeros((n, _WINDOWS), np.int32)
     host_ok = np.zeros(n, bool)
     for i, (pk, msg, sig) in enumerate(zip(public_keys, messages, signatures)):
         if len(pk) != 32 or len(sig) != 64:
@@ -246,8 +330,8 @@ def pack_batch(
         r_limbs, rs, _ry = _ylimbs_and_sign(r_bytes)
         r_y[i], r_sign[i] = r_limbs, rs
         k = int.from_bytes(hashlib.sha512(r_bytes + pk + msg).digest(), "little") % L
-        s_bits[i] = _bits_msb_first(s)
-        k_bits[i] = _bits_msb_first(k)
+        s_bits[i] = _windows_lsb_first(s)
+        k_bits[i] = _windows_lsb_first(k)
         host_ok[i] = True
     return a_y, a_sign, r_y, r_sign, s_bits, k_bits, host_ok
 

@@ -109,11 +109,22 @@ def _fold_reduce(wide: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply: schoolbook outer product + anti-diagonal scatter-add."""
-    prod = a[..., :, None] * b[..., None, :]  # (..., 20, 20), each ≤ 2^26
-    flat = prod.reshape(*prod.shape[:-2], NLIMBS * NLIMBS)
-    wide = jnp.zeros((*flat.shape[:-1], _WORK), dtype=jnp.int32)
-    wide = wide.at[..., _DIAG].add(flat)
+    """Field multiply: schoolbook product via STATIC shifted adds.
+
+    The anti-diagonal accumulation is expressed as 20 statically-padded
+    vector adds (one per limb of ``a``) rather than a scatter — XLA lowers
+    scatters with duplicate indices to a serialized loop on TPU, while pads
+    and adds stay fully lane-parallel on the VPU.
+    """
+    parts = []
+    for i in range(NLIMBS):
+        term = a[..., i : i + 1] * b  # (..., 20), each ≤ 2^26
+        parts.append(
+            jnp.pad(term, [(0, 0)] * (term.ndim - 1) + [(i, _WORK - NLIMBS - i)])
+        )
+    wide = parts[0]
+    for p in parts[1:]:
+        wide = wide + p
     return _fold_reduce(wide)
 
 
