@@ -1,0 +1,31 @@
+"""Benchmark orchestration: deploy validators, drive load, scrape, summarize.
+
+Capability parity with the reference's ``orchestrator/`` crate, re-targeted:
+the reference provisions AWS/Vultr over SSH (client/aws.rs, client/vultr.rs,
+ssh.rs); this framework ships a provider-agnostic ``Runner`` seam with a fully
+supported local multiprocess runner (the dry-run/testbed scale) and an
+ssh-CLI-based runner for real fleets — no cloud SDK dependency.
+
+Modules:
+  measurement — prometheus scrape parsing + tps/latency aggregation
+                (orchestrator/src/measurement.rs)
+  benchmark   — benchmark parameters, fixed-load and max-load binary search
+                (orchestrator/src/benchmark.rs)
+  faults      — permanent / crash-recovery fault schedules
+                (orchestrator/src/faults.rs)
+  runner      — LocalProcessRunner + SshRunner (orchestrator.rs + ssh.rs)
+  orchestrator— the benchmark lifecycle loop (orchestrator.rs:523-727)
+"""
+from .benchmark import BenchmarkParameters, LoadType, ParametersGenerator
+from .faults import CrashRecoverySchedule, FaultsType
+from .measurement import Measurement, MeasurementsCollection
+
+__all__ = [
+    "BenchmarkParameters",
+    "LoadType",
+    "ParametersGenerator",
+    "FaultsType",
+    "CrashRecoverySchedule",
+    "Measurement",
+    "MeasurementsCollection",
+]

@@ -1,0 +1,177 @@
+"""Prometheus scrape parsing and benchmark aggregation.
+
+Capability parity with ``orchestrator/src/measurement.rs``:
+
+* ``Measurement.from_prometheus`` (:45-106) — extract the benchmark-defining
+  series {buckets, sum, count, squared_sum} for a workload label plus
+  ``benchmark_duration``.
+* throughput = count / duration (:109-117); average latency = sum/count;
+  stdev = sqrt(squared_sum/count - avg^2) (:121-142).
+* ``MeasurementsCollection`` (:163-281) — per-scraper time series, aggregation
+  across validators, JSON save/load, display summary (:283-360).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_RE_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([0-9.eE+-]+|NaN)")
+
+
+def _labels(raw: Optional[str]) -> Dict[str, str]:
+    if not raw:
+        return {}
+    out = {}
+    for part in raw.strip("{}").split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k] = v.strip('"')
+    return out
+
+
+@dataclass
+class Measurement:
+    """One scrape's benchmark numbers for one workload label."""
+
+    timestamp_s: float = 0.0
+    benchmark_duration_s: float = 0.0
+    buckets: Dict[str, float] = field(default_factory=dict)
+    sum_s: float = 0.0
+    count: int = 0
+    squared_sum_s: float = 0.0
+
+    @classmethod
+    def from_prometheus(cls, text: str, workload: str = "shared") -> "Measurement":
+        m = cls(timestamp_s=time.time())
+        for line in text.splitlines():
+            match = _RE_LINE.match(line)
+            if not match:
+                continue
+            name, raw_labels, raw_value = match.groups()
+            labels = _labels(raw_labels)
+            try:
+                value = float(raw_value)
+            except ValueError:
+                continue
+            if name == "benchmark_duration_total" or name == "benchmark_duration":
+                m.benchmark_duration_s = value
+            elif labels.get("workload") != workload:
+                continue
+            elif name == "latency_s_bucket":
+                m.buckets[labels.get("le", "")] = value
+            elif name == "latency_s_sum":
+                m.sum_s = value
+            elif name == "latency_s_count":
+                m.count = int(value)
+            elif name in ("latency_squared_s_total", "latency_squared_s"):
+                m.squared_sum_s = value
+        return m
+
+    def tps(self) -> float:
+        if self.benchmark_duration_s == 0:
+            return 0.0
+        return self.count / self.benchmark_duration_s
+
+    def avg_latency_s(self) -> float:
+        return self.sum_s / self.count if self.count else 0.0
+
+    def stdev_latency_s(self) -> float:
+        """sqrt(E[X^2] - E[X]^2) (measurement.rs:121-142)."""
+        if not self.count:
+            return 0.0
+        first = self.squared_sum_s / self.count
+        second = self.avg_latency_s() ** 2
+        return math.sqrt(max(0.0, first - second))
+
+    def to_dict(self) -> dict:
+        return {
+            "timestamp_s": self.timestamp_s,
+            "benchmark_duration_s": self.benchmark_duration_s,
+            "buckets": self.buckets,
+            "sum_s": self.sum_s,
+            "count": self.count,
+            "squared_sum_s": self.squared_sum_s,
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Measurement":
+        return cls(**raw)
+
+
+class MeasurementsCollection:
+    """Per-scraper measurement series + cross-validator aggregation
+    (measurement.rs:163-360)."""
+
+    def __init__(self, parameters: Optional[dict] = None) -> None:
+        self.parameters = parameters or {}
+        self.scrapers: Dict[str, List[Measurement]] = {}
+
+    def add(self, scraper_id: str, measurement: Measurement) -> None:
+        self.scrapers.setdefault(scraper_id, []).append(measurement)
+
+    def _last_measurements(self) -> List[Measurement]:
+        return [series[-1] for series in self.scrapers.values() if series]
+
+    def benchmark_duration(self) -> float:
+        last = self._last_measurements()
+        return max((m.benchmark_duration_s for m in last), default=0.0)
+
+    def aggregate_tps(self) -> float:
+        """Sum of per-validator counts over the max duration (measurement.rs:236-250)."""
+        duration = self.benchmark_duration()
+        if duration == 0:
+            return 0.0
+        total = sum(m.count for m in self._last_measurements())
+        return total / duration
+
+    def aggregate_average_latency_s(self) -> float:
+        last = self._last_measurements()
+        count = sum(m.count for m in last)
+        if not count:
+            return 0.0
+        return sum(m.sum_s for m in last) / count
+
+    def aggregate_stdev_latency_s(self) -> float:
+        last = self._last_measurements()
+        count = sum(m.count for m in last)
+        if not count:
+            return 0.0
+        first = sum(m.squared_sum_s for m in last) / count
+        second = self.aggregate_average_latency_s() ** 2
+        return math.sqrt(max(0.0, first - second))
+
+    def save(self, path: str) -> None:
+        data = {
+            "parameters": self.parameters,
+            "scrapers": {
+                k: [m.to_dict() for m in v] for k, v in self.scrapers.items()
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1)
+
+    @classmethod
+    def load(cls, path: str) -> "MeasurementsCollection":
+        with open(path) as f:
+            raw = json.load(f)
+        c = cls(raw.get("parameters"))
+        for k, series in raw.get("scrapers", {}).items():
+            c.scrapers[k] = [Measurement.from_dict(m) for m in series]
+        return c
+
+    def display_summary(self) -> str:
+        lines = [
+            "Benchmark summary",
+            "-----------------",
+            f" duration:      {self.benchmark_duration():.0f} s",
+            f" tps:           {self.aggregate_tps():.0f} tx/s",
+            f" avg latency:   {self.aggregate_average_latency_s() * 1000:.0f} ms",
+            f" stdev latency: {self.aggregate_stdev_latency_s() * 1000:.0f} ms",
+        ]
+        return "\n".join(lines)

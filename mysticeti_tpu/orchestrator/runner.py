@@ -1,0 +1,206 @@
+"""Deployment runners: local multiprocess fleet and ssh-CLI remote fleet.
+
+Capability parity with ``orchestrator/src/orchestrator.rs`` (boot_nodes :215,
+run_nodes :476, kill/cleanup) + ``ssh.rs`` — re-targeted: the reference shells
+into cloud instances over libssh2 and runs binaries under tmux; here the
+``Runner`` seam abstracts "start validator i / kill validator i / scrape i":
+
+* ``LocalProcessRunner`` — subprocess per validator on localhost (the dry-run
+  scale, fully tested in CI);
+* ``SshRunner`` — same operations through the system ``ssh`` binary with
+  ``nohup`` (no cloud SDK / libssh dependency; provisioning is out of scope —
+  point it at any fleet of reachable hosts).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ..cli import benchmark_genesis
+from ..config import Parameters
+
+
+class Runner:
+    async def configure(self, committee_size: int) -> None:
+        raise NotImplementedError
+
+    async def boot_node(self, authority: int) -> None:
+        raise NotImplementedError
+
+    async def kill_node(self, authority: int) -> None:
+        raise NotImplementedError
+
+    async def scrape(self, authority: int) -> Optional[str]:
+        """Fetch the node's /metrics text, or None when unreachable."""
+        raise NotImplementedError
+
+    async def cleanup(self) -> None:
+        raise NotImplementedError
+
+
+async def _http_get_metrics(host: str, port: int, timeout: float = 5.0) -> Optional[str]:
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout=timeout
+        )
+        writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(-1), timeout=timeout)
+        writer.close()
+        body = data.split(b"\r\n\r\n", 1)
+        return body[1].decode() if len(body) == 2 else None
+    except (OSError, asyncio.TimeoutError):
+        return None
+
+
+class LocalProcessRunner(Runner):
+    def __init__(
+        self,
+        working_dir: str,
+        tps_per_node: int = 100,
+        verifier: str = "accept",
+    ) -> None:
+        self.working_dir = working_dir
+        self.tps_per_node = tps_per_node
+        self.verifier = verifier
+        self.committee_size = 0
+        self.processes: Dict[int, asyncio.subprocess.Process] = {}
+        self.parameters: Optional[Parameters] = None
+
+    async def configure(self, committee_size: int) -> None:
+        self.committee_size = committee_size
+        benchmark_genesis(["127.0.0.1"] * committee_size, self.working_dir)
+        self.parameters = Parameters.load(
+            os.path.join(self.working_dir, "parameters.yaml")
+        )
+
+    async def boot_node(self, authority: int) -> None:
+        env = dict(os.environ)
+        env["TPS"] = str(self.tps_per_node)
+        env.setdefault("INITIAL_DELAY", "1")
+        log = open(os.path.join(self.working_dir, f"node-{authority}.log"), "ab")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "mysticeti_tpu",
+            "run",
+            "--authority",
+            str(authority),
+            "--committee-path",
+            os.path.join(self.working_dir, "committee.yaml"),
+            "--parameters-path",
+            os.path.join(self.working_dir, "parameters.yaml"),
+            "--private-config-path",
+            os.path.join(self.working_dir, f"validator-{authority}"),
+            "--verifier",
+            self.verifier,
+            env=env,
+            stdout=log,
+            stderr=log,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        )
+        self.processes[authority] = proc
+
+    async def kill_node(self, authority: int) -> None:
+        proc = self.processes.pop(authority, None)
+        if proc is not None and proc.returncode is None:
+            proc.send_signal(signal.SIGKILL)
+            await proc.wait()
+
+    async def scrape(self, authority: int) -> Optional[str]:
+        host, port = self.parameters.metrics_address(authority)
+        return await _http_get_metrics("127.0.0.1", port)
+
+    async def cleanup(self) -> None:
+        for authority in list(self.processes):
+            await self.kill_node(authority)
+
+
+class SshRunner(Runner):
+    """Remote fleet through the system ssh binary (ssh.rs re-imagined).
+
+    ``hosts``: one reachable address per validator.  Assumes the repo is
+    deployed at ``remote_repo`` on every host (the reference's install/update
+    steps, orchestrator.rs:281-475, are a deployment concern left to the
+    operator or a one-line ``git clone`` per host).
+    """
+
+    def __init__(
+        self,
+        hosts: List[str],
+        remote_repo: str,
+        working_dir: str = "/tmp/mysticeti-bench",
+        python: str = "python3",
+        tps_per_node: int = 100,
+        verifier: str = "tpu",
+        ssh_args: Optional[List[str]] = None,
+    ) -> None:
+        self.hosts = hosts
+        self.remote_repo = remote_repo
+        self.working_dir = working_dir
+        self.python = python
+        self.tps_per_node = tps_per_node
+        self.verifier = verifier
+        self.ssh_args = ssh_args or ["-o", "StrictHostKeyChecking=no"]
+        self.parameters: Optional[Parameters] = None
+
+    async def _ssh(self, host: str, command: str) -> Tuple[int, bytes]:
+        proc = await asyncio.create_subprocess_exec(
+            "ssh",
+            *self.ssh_args,
+            host,
+            command,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        out, _ = await proc.communicate()
+        return proc.returncode or 0, out
+
+    async def configure(self, committee_size: int) -> None:
+        assert committee_size <= len(self.hosts)
+        import tempfile
+
+        local = tempfile.mkdtemp(prefix="mysticeti-genesis-")
+        benchmark_genesis(self.hosts[:committee_size], local)
+        self.parameters = Parameters.load(os.path.join(local, "parameters.yaml"))
+        for i, host in enumerate(self.hosts[:committee_size]):
+            await self._ssh(host, f"mkdir -p {self.working_dir}")
+            proc = await asyncio.create_subprocess_exec(
+                "scp",
+                *self.ssh_args,
+                "-r",
+                os.path.join(local, "committee.yaml"),
+                os.path.join(local, "parameters.yaml"),
+                os.path.join(local, f"validator-{i}"),
+                f"{host}:{self.working_dir}/",
+            )
+            await proc.wait()
+
+    async def boot_node(self, authority: int) -> None:
+        host = self.hosts[authority]
+        cmd = (
+            f"cd {self.remote_repo} && TPS={self.tps_per_node} nohup {self.python} -m"
+            f" mysticeti_tpu run --authority {authority}"
+            f" --committee-path {self.working_dir}/committee.yaml"
+            f" --parameters-path {self.working_dir}/parameters.yaml"
+            f" --private-config-path {self.working_dir}/validator-{authority}"
+            f" --verifier {self.verifier}"
+            f" > {self.working_dir}/node.log 2>&1 & echo started"
+        )
+        await self._ssh(host, cmd)
+
+    async def kill_node(self, authority: int) -> None:
+        await self._ssh(
+            self.hosts[authority], "pkill -f 'mysticeti_tpu run' || true"
+        )
+
+    async def scrape(self, authority: int) -> Optional[str]:
+        host, port = self.parameters.metrics_address(authority)
+        return await _http_get_metrics(self.hosts[authority].split("@")[-1], port)
+
+    async def cleanup(self) -> None:
+        for i in range(len(self.hosts)):
+            await self.kill_node(i)

@@ -1,0 +1,148 @@
+"""Orchestrator harness tests: scrape parsing, search state machine, fault
+schedule, and a short end-to-end local multiprocess benchmark
+(measurement.rs:362-468 / benchmark.rs:310-391 tiers)."""
+import asyncio
+import os
+
+import pytest
+
+from mysticeti_tpu.orchestrator import (
+    BenchmarkParameters,
+    CrashRecoverySchedule,
+    FaultsType,
+    LoadType,
+    Measurement,
+    MeasurementsCollection,
+    ParametersGenerator,
+)
+
+SCRAPE = """
+# HELP latency_s end-to-end tx latency
+latency_s_bucket{workload="shared",le="0.1"} 5.0
+latency_s_bucket{workload="shared",le="0.5"} 85.0
+latency_s_bucket{workload="shared",le="+Inf"} 100.0
+latency_s_sum{workload="shared"} 31.5
+latency_s_count{workload="shared"} 100
+latency_squared_s_total{workload="shared"} 13.5
+latency_s_sum{workload="owned"} 1.0
+latency_s_count{workload="owned"} 3
+benchmark_duration_total 50.0
+"""
+
+
+def test_measurement_from_prometheus():
+    m = Measurement.from_prometheus(SCRAPE, "shared")
+    assert m.count == 100
+    assert m.sum_s == 31.5
+    assert m.squared_sum_s == 13.5
+    assert m.benchmark_duration_s == 50.0
+    assert m.tps() == pytest.approx(2.0)
+    assert m.avg_latency_s() == pytest.approx(0.315)
+    assert m.stdev_latency_s() == pytest.approx((13.5 / 100 - 0.315**2) ** 0.5)
+    assert m.buckets["0.5"] == 85.0
+
+
+def test_measurements_collection_aggregation(tmp_path):
+    c = MeasurementsCollection({"nodes": 2})
+    for node in ("0", "1"):
+        c.add(node, Measurement.from_prometheus(SCRAPE, "shared"))
+    assert c.aggregate_tps() == pytest.approx(4.0)  # 200 tx over max 50 s
+    assert c.aggregate_average_latency_s() == pytest.approx(0.315)
+    path = str(tmp_path / "m.json")
+    c.save(path)
+    loaded = MeasurementsCollection.load(path)
+    assert loaded.aggregate_tps() == pytest.approx(4.0)
+    assert "tps" in loaded.display_summary()
+
+
+def _collection(load, tps, latency):
+    c = MeasurementsCollection()
+    m = Measurement(
+        benchmark_duration_s=10.0,
+        count=int(tps * 10),
+        sum_s=latency * tps * 10,
+        squared_sum_s=latency * latency * tps * 10,
+    )
+    c.add("0", m)
+    return c
+
+
+def test_search_generator_doubles_then_bisects():
+    gen = ParametersGenerator(4, LoadType.search(100, max_iterations=10), duration_s=1)
+    p1 = gen.next_parameters()
+    assert p1.load == 100
+    gen.register_result(p1, _collection(100, tps=100, latency=0.2))  # sustained
+    p2 = gen.next_parameters()
+    assert p2.load == 200  # doubled
+    gen.register_result(p2, _collection(200, tps=200, latency=0.2))
+    p3 = gen.next_parameters()
+    assert p3.load == 400
+    # breaking point: tps collapses below 2/3 of offered
+    gen.register_result(p3, _collection(400, tps=100, latency=0.3))
+    p4 = gen.next_parameters()
+    assert p4.load == 300  # bisect between 200 and 400
+    gen.register_result(p4, _collection(300, tps=295, latency=0.25))
+    assert gen.max_sustainable_load() == 300
+
+
+def test_out_of_capacity_latency_spike():
+    gen = ParametersGenerator(4, LoadType.search(100), duration_s=1)
+    p = gen.next_parameters()
+    gen.register_result(p, _collection(100, tps=100, latency=0.1))
+    p2 = gen.next_parameters()
+    spiked = _collection(200, tps=200, latency=0.9)  # > 5x previous latency
+    assert gen.out_of_capacity(p2, spiked)
+
+
+def test_fixed_generator():
+    gen = ParametersGenerator(4, LoadType.fixed([50, 100]), duration_s=1)
+    loads = []
+    while (p := gen.next_parameters()) is not None:
+        loads.append(p.load)
+        gen.register_result(p, _collection(p.load, p.load, 0.1))
+    assert loads == [50, 100]
+
+
+def test_crash_recovery_schedule():
+    sched = CrashRecoverySchedule(FaultsType.crash_recovery(3), committee_size=10)
+    killed, booted = set(), []
+    for _ in range(6):
+        k, b = sched.update()
+        killed.update(k)
+        booted.extend(b)
+    assert killed, "some nodes must die"
+    assert all(n >= 7 for n in killed), "only the fault budget tail dies"
+    assert booted, "crash-recovery must also boot nodes back"
+
+
+def test_permanent_schedule():
+    sched = CrashRecoverySchedule(FaultsType.permanent(2), committee_size=4)
+    k, b = sched.update()
+    assert k == [2, 3] and b == []
+    assert sched.update() == ([], [])
+
+
+def test_local_benchmark_end_to_end(tmp_path):
+    """Short real benchmark: 3 local validator subprocesses, one scrape cycle."""
+    from mysticeti_tpu.orchestrator.orchestrator import Orchestrator
+    from mysticeti_tpu.orchestrator.runner import LocalProcessRunner
+
+    async def main():
+        runner = LocalProcessRunner(str(tmp_path / "fleet"), tps_per_node=20)
+        gen = ParametersGenerator(3, LoadType.fixed([60]), duration_s=14.0)
+        orch = Orchestrator(
+            runner,
+            gen,
+            results_dir=str(tmp_path / "results"),
+            scrape_interval_s=7.0,
+        )
+        collections = await orch.run_benchmarks()
+        return collections
+
+    collections = asyncio.run(main())
+    assert len(collections) == 1
+    c = collections[0]
+    assert c.scrapers, "no scrapes succeeded"
+    assert c.benchmark_duration() > 0
+    assert c.aggregate_tps() > 0, c.display_summary()
+    assert os.path.exists(str(tmp_path / "results" / "measurements-0.json"))
