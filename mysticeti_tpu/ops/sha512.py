@@ -1,0 +1,225 @@
+"""SHA-512 compression on TPU in 32-bit lanes — the fused digest+verify path.
+
+The Ed25519 challenge scalar is k = SHA-512(R || A || M) where M is the fixed
+32-byte signed block digest (types.py signed_digest), so the hash input is
+always 96 bytes = ONE padded 1024-bit block.  This kernel evaluates that single
+compression for a whole batch at once, with every 64-bit word represented as a
+(hi, lo) pair of uint32 lanes (TPUs have no 64-bit integer datapath):
+
+* add: uint32 wrap + carry-out via unsigned compare,
+* rotr/shr: static shift pairs (the round structure is fully unrolled — 80
+  rounds of straight-line vector ops, exactly what XLA fuses well).
+
+Parity with ``hashlib.sha512`` is enforced in tests/test_sha512_tpu.py.
+Reference context: the CPU path computes this hash per signature on the host
+(crypto.rs:174-189 + RFC 8032); batching it on device removes the last serial
+per-item hash from the verification pipeline (BASELINE config #4, "fused").
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+Word = Tuple[jnp.ndarray, jnp.ndarray]  # (hi, lo) uint32
+
+_K = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+
+_H0 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+
+def _const(x: int) -> Word:
+    return (
+        jnp.uint32((x >> 32) & 0xFFFFFFFF),
+        jnp.uint32(x & 0xFFFFFFFF),
+    )
+
+
+def _add(a: Word, b: Word) -> Word:
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    hi = a[0] + b[0] + carry
+    return hi, lo
+
+
+def _add_many(*words: Word) -> Word:
+    acc = words[0]
+    for w in words[1:]:
+        acc = _add(acc, w)
+    return acc
+
+
+def _xor(a: Word, b: Word) -> Word:
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _and(a: Word, b: Word) -> Word:
+    return a[0] & b[0], a[1] & b[1]
+
+
+def _not(a: Word) -> Word:
+    return ~a[0], ~a[1]
+
+
+def _rotr(a: Word, n: int) -> Word:
+    hi, lo = a
+    if n == 0:
+        return a
+    if n < 32:
+        return (
+            (hi >> n) | (lo << (32 - n)),
+            (lo >> n) | (hi << (32 - n)),
+        )
+    if n == 32:
+        return lo, hi
+    n -= 32
+    return (
+        (lo >> n) | (hi << (32 - n)),
+        (hi >> n) | (lo << (32 - n)),
+    )
+
+
+def _shr(a: Word, n: int) -> Word:
+    hi, lo = a
+    if n < 32:
+        return hi >> n, (lo >> n) | (hi << (32 - n))
+    if n == 32:
+        return jnp.zeros_like(hi), hi
+    return jnp.zeros_like(hi), hi >> (n - 32)
+
+
+def _big_sigma0(x: Word) -> Word:
+    return _xor(_xor(_rotr(x, 28), _rotr(x, 34)), _rotr(x, 39))
+
+
+def _big_sigma1(x: Word) -> Word:
+    return _xor(_xor(_rotr(x, 14), _rotr(x, 18)), _rotr(x, 41))
+
+
+def _small_sigma0(x: Word) -> Word:
+    return _xor(_xor(_rotr(x, 1), _rotr(x, 8)), _shr(x, 7))
+
+
+def _small_sigma1(x: Word) -> Word:
+    return _xor(_xor(_rotr(x, 19), _rotr(x, 61)), _shr(x, 6))
+
+
+def _ch(e: Word, f: Word, g: Word) -> Word:
+    return _xor(_and(e, f), _and(_not(e), g))
+
+
+def _maj(a: Word, b: Word, c: Word) -> Word:
+    return _xor(_xor(_and(a, b), _and(a, c)), _and(b, c))
+
+
+# Round constants as device arrays (hi, lo), shaped (80,).
+_K_HI = jnp.asarray(np.array([(k >> 32) & 0xFFFFFFFF for k in _K], np.uint32))
+_K_LO = jnp.asarray(np.array([k & 0xFFFFFFFF for k in _K], np.uint32))
+
+
+def sha512_96(words: jnp.ndarray) -> jnp.ndarray:
+    """SHA-512 of a 96-byte message given as (..., 24) big-endian uint32 words.
+
+    Returns the (..., 16) uint32 digest words (big-endian pairs).  The padding
+    for a 96-byte message (0x80 then zeros then bit length 768) is appended
+    in-kernel, so callers pass exactly R||A||M.  Both the message schedule and
+    the 80 compression rounds run under ``lax.scan`` so the compiled graph
+    stays small (a naive unroll is ~12k ops and chokes XLA).
+    """
+    shape = words.shape[:-1]
+
+    def lift(x: int) -> Word:
+        hi, lo = _const(x)
+        return jnp.broadcast_to(hi, shape), jnp.broadcast_to(lo, shape)
+
+    # Initial 16-word window: 12 message words + fixed padding.
+    pad = [lift(0x8000000000000000), lift(0), lift(0), lift(96 * 8)]
+    window_hi = jnp.stack(
+        [words[..., 2 * t] for t in range(12)] + [p[0] for p in pad], axis=0
+    )  # (16, ...)
+    window_lo = jnp.stack(
+        [words[..., 2 * t + 1] for t in range(12)] + [p[1] for p in pad], axis=0
+    )
+
+    def schedule_step(carry, _):
+        whi, wlo = carry  # (16, ...)
+        s1 = _small_sigma1((whi[14], wlo[14]))
+        s0 = _small_sigma0((whi[1], wlo[1]))
+        new = _add_many(s1, (whi[9], wlo[9]), s0, (whi[0], wlo[0]))
+        whi = jnp.concatenate([whi[1:], new[0][None]], axis=0)
+        wlo = jnp.concatenate([wlo[1:], new[1][None]], axis=0)
+        return (whi, wlo), (whi[15], wlo[15])
+
+    # Emit all 80 schedule words: the first 16 are the initial window.
+    (_, _), tail = jax.lax.scan(
+        schedule_step, (window_hi, window_lo), None, length=64
+    )
+    w_hi = jnp.concatenate([window_hi, tail[0]], axis=0)  # (80, ...)
+    w_lo = jnp.concatenate([window_lo, tail[1]], axis=0)
+
+    def round_step(state, xs):
+        a, b, c, d, e, f, g, h = [
+            (state[2 * i], state[2 * i + 1]) for i in range(8)
+        ]
+        whi, wlo, khi, klo = xs
+        t1 = _add_many(h, _big_sigma1(e), _ch(e, f, g), (khi, klo), (whi, wlo))
+        t2 = _add(_big_sigma0(a), _maj(a, b, c))
+        h, g, f = g, f, e
+        e = _add(d, t1)
+        d, c, b = c, b, a
+        a = _add(t1, t2)
+        return tuple(x for p in (a, b, c, d, e, f, g, h) for x in p), None
+
+    init = tuple(x for h0 in _H0 for x in lift(h0))
+    state, _ = jax.lax.scan(round_step, init, (w_hi, w_lo, _K_HI, _K_LO))
+
+    out = []
+    for i, h0 in enumerate(_H0):
+        s = _add((state[2 * i], state[2 * i + 1]), lift(h0))
+        out.extend(s)
+    return jnp.stack(out, axis=-1)
+
+
+def pack_messages(messages: "list[bytes]") -> np.ndarray:
+    """(N, 24) big-endian uint32 words from 96-byte messages (host side)."""
+    out = np.zeros((len(messages), 24), np.uint32)
+    for i, m in enumerate(messages):
+        assert len(m) == 96
+        out[i] = np.frombuffer(m, dtype=">u4").astype(np.uint32)
+    return out
+
+
+def digest_bytes(digest_words: np.ndarray) -> "list[bytes]":
+    """Inverse of the device output: (N, 16) words -> 64-byte digests."""
+    arr = np.asarray(digest_words, dtype=np.uint32)
+    out = []
+    for row in arr:
+        out.append(row.astype(">u4").tobytes())
+    return out

@@ -1,0 +1,38 @@
+"""SHA-512 kernel parity with hashlib over the 96-byte (R||A||M) block shape."""
+import hashlib
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mysticeti_tpu.ops import sha512 as S
+
+
+def test_sha512_96_matches_hashlib():
+    rng = random.Random(11)
+    messages = [bytes(rng.randrange(256) for _ in range(96)) for _ in range(32)]
+    messages += [b"\x00" * 96, b"\xff" * 96]
+    packed = jnp.asarray(S.pack_messages(messages))
+    digests = S.digest_bytes(np.asarray(jax.jit(S.sha512_96)(packed)))
+    for msg, got in zip(messages, digests):
+        assert got == hashlib.sha512(msg).digest(), msg.hex()
+
+
+def test_sha512_96_is_the_ed25519_challenge_shape():
+    """The exact production shape: R || A || blake2b-256 block digest."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    from mysticeti_tpu import crypto
+    from mysticeti_tpu.types import StatementBlock
+
+    signer = crypto.Signer.from_seed(b"sha512-kernel-test-seed-00000000")
+    block = StatementBlock.build(0, 1, [], (), signer=signer)
+    r_bytes = block.signature[:32]
+    a_bytes = signer.public_key.bytes
+    m = block.signed_digest()
+    msg = r_bytes + a_bytes + m
+    packed = jnp.asarray(S.pack_messages([msg]))
+    [digest] = S.digest_bytes(np.asarray(jax.jit(S.sha512_96)(packed)))
+    assert digest == hashlib.sha512(msg).digest()
