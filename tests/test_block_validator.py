@@ -1,0 +1,139 @@
+"""Batched signature-verification seam tests: the collector's size/deadline
+policy and the end-to-end validator path with real signature checking."""
+import asyncio
+
+import pytest
+
+from mysticeti_tpu.block_validator import (
+    BatchedSignatureVerifier,
+    CpuSignatureVerifier,
+)
+from mysticeti_tpu.committee import Authority, Committee
+from mysticeti_tpu.crypto import Signer
+from mysticeti_tpu.types import StatementBlock, VerificationError
+
+
+@pytest.fixture
+def committee_and_signers():
+    signers = Committee.benchmark_signers(4)
+    committee = Committee([Authority(1, s.public_key) for s in signers])
+    return committee, signers
+
+
+class CountingVerifier(CpuSignatureVerifier):
+    def __init__(self):
+        self.calls = []
+
+    def verify_signatures(self, public_keys, digests, signatures):
+        self.calls.append(len(signatures))
+        return super().verify_signatures(public_keys, digests, signatures)
+
+
+def test_batch_collector_deadline(committee_and_signers):
+    """Blocks arriving under max_batch are flushed by the deadline, as one call."""
+    committee, signers = committee_and_signers
+
+    async def main():
+        backend = CountingVerifier()
+        verifier = BatchedSignatureVerifier(
+            committee, backend, max_batch=100, max_delay_s=0.02
+        )
+        blocks = [
+            StatementBlock.build(a, 1, [], (), signer=signers[a]) for a in range(4)
+        ]
+        await asyncio.gather(*(verifier.verify(b) for b in blocks))
+        assert backend.calls == [4], backend.calls
+
+    asyncio.run(main())
+
+
+def test_batch_collector_size_trigger(committee_and_signers):
+    committee, signers = committee_and_signers
+
+    async def main():
+        backend = CountingVerifier()
+        verifier = BatchedSignatureVerifier(
+            committee, backend, max_batch=2, max_delay_s=10.0
+        )
+        blocks = [
+            StatementBlock.build(a, 1, [], (), signer=signers[a]) for a in range(4)
+        ]
+        await asyncio.gather(*(verifier.verify(b) for b in blocks))
+        assert sum(backend.calls) == 4
+        assert max(backend.calls) <= 2
+
+    asyncio.run(main())
+
+
+def test_batch_collector_rejects_bad_signature(committee_and_signers):
+    committee, signers = committee_and_signers
+
+    async def main():
+        verifier = BatchedSignatureVerifier(
+            committee, CpuSignatureVerifier(), max_batch=10, max_delay_s=0.01
+        )
+        good = StatementBlock.build(0, 1, [], (), signer=signers[0])
+        forged = StatementBlock.build(1, 1, [], (), signer=signers[0])  # wrong key
+        results = await asyncio.gather(
+            verifier.verify(good), verifier.verify(forged), return_exceptions=True
+        )
+        assert results[0] is None
+        assert isinstance(results[1], VerificationError)
+
+    asyncio.run(main())
+
+
+def test_validators_with_cpu_signature_verification(tmp_path):
+    """4 localhost validators with full signature verification through the
+    batching collector still commit (BASELINE config #1)."""
+    import socket
+
+    from mysticeti_tpu.config import Identifier, Parameters, PrivateConfig
+    from mysticeti_tpu.validator import Validator
+
+    def free_ports(n):
+        socks, ports = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        return ports
+
+    async def main():
+        ports = free_ports(8)
+        identifiers = [
+            Identifier("127.0.0.1", ports[2 * i], ports[2 * i + 1]) for i in range(4)
+        ]
+        parameters = Parameters(identifiers=identifiers, leader_timeout_s=0.5)
+        signers = Committee.benchmark_signers(4)
+        committee = Committee([Authority(1, s.public_key) for s in signers])
+        validators = [
+            await Validator.start_benchmarking(
+                i,
+                committee,
+                parameters,
+                PrivateConfig.new_in_dir(i, str(tmp_path / f"v{i}")),
+                signer=signers[i],
+                tps=20,
+                serve_metrics_endpoint=False,
+                verifier="cpu",
+            )
+            for i in range(4)
+        ]
+        try:
+
+            async def poll():
+                while True:
+                    if all(len(v.committed_leaders()) >= 2 for v in validators):
+                        return
+                    await asyncio.sleep(0.2)
+
+            await asyncio.wait_for(poll(), timeout=60)
+        finally:
+            for v in validators:
+                await v.stop()
+
+    asyncio.run(main())
