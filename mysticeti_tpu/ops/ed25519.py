@@ -327,7 +327,13 @@ def pack_batch(
         if y >= P:
             continue  # non-canonical A encoding
         a_y[i], a_sign[i] = limbs, sign
-        r_limbs, rs, _ry = _ylimbs_and_sign(r_bytes)
+        r_limbs, rs, ry = _ylimbs_and_sign(r_bytes)
+        if ry >= P:
+            # Non-canonical R encoding: OpenSSL's memcmp of encode([s]B - [k]A)
+            # against the raw R bytes can never match a y >= p encoding, so
+            # reject on host.  Keeps the device compare (eq_canonical, which
+            # would reduce mod p) exactly equivalent to memcmp semantics.
+            continue
         r_y[i], r_sign[i] = r_limbs, rs
         k = int.from_bytes(hashlib.sha512(r_bytes + pk + msg).digest(), "little") % L
         s_bits[i] = _windows_lsb_first(s)

@@ -1,0 +1,93 @@
+"""Parity tests for the Pallas verify kernel (interpret mode on CPU).
+
+The Pallas kernel must agree bit-for-bit with the XLA kernel
+(``ops/ed25519.verify_impl``) and with the OpenSSL oracle over valid,
+corrupted, and structurally-invalid signatures (the same contract the
+reference's serial verify upholds, ``mysticeti-core/src/crypto.rs:174-189``).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from mysticeti_tpu.ops import ed25519 as E
+from mysticeti_tpu.ops import ed25519_pallas as EP
+
+
+def _batch(n, seed=1, corrupt=True):
+    rng = random.Random(seed)
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.randrange(256) for _ in range(32))
+        )
+        for _ in range(8)
+    ]
+    pks, msgs, sigs, expect = [], [], [], []
+    for i in range(n):
+        key = keys[i % len(keys)]
+        msg = bytes(rng.randrange(256) for _ in range(32))
+        sig = bytearray(key.sign(msg))
+        ok = True
+        if corrupt and i % 4 == 1:
+            sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            ok = False
+        elif corrupt and i % 4 == 2:
+            msg = bytes(rng.randrange(256) for _ in range(32))
+            ok = False
+        pks.append(key.public_key().public_bytes_raw())
+        msgs.append(msg)
+        sigs.append(bytes(sig))
+        expect.append(ok)
+    return pks, msgs, sigs, np.array(expect)
+
+
+def test_pallas_matches_oracle_and_xla():
+    pks, msgs, sigs, expect = _batch(16)
+    packed = E.pack_batch(pks, msgs, sigs)
+    ref = np.asarray(E.verify_kernel(*[np.asarray(x) for x in packed]))
+    got = np.asarray(EP.verify_pallas(*packed, tile=8, interpret=True))
+    # The corrupted signature may still occasionally pass host checks; the
+    # oracle is the cryptography library's accept/reject per item.
+    from mysticeti_tpu import crypto
+
+    oracle = np.array(
+        [crypto.PublicKey(p).verify(s, m) for p, m, s in zip(pks, msgs, sigs)]
+    )
+    np.testing.assert_array_equal(got, oracle)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pallas_multi_tile_grid():
+    """Two grid tiles: catches block-index mapping errors."""
+    pks, msgs, sigs, expect = _batch(16, seed=3, corrupt=False)
+    packed = E.pack_batch(pks, msgs, sigs)
+    got = np.asarray(EP.verify_pallas(*packed, tile=8, interpret=True))
+    assert got.all()
+
+
+def test_non_canonical_r_rejected_on_host():
+    """A signature whose R y-coordinate is encoded as y >= p must be rejected
+    in pack_batch (OpenSSL memcmp semantics: a non-canonical encoding can
+    never equal the canonical re-encoding).  ADVICE r1 finding."""
+    from mysticeti_tpu.ops.ed25519 import P, pack_batch
+
+    pks, msgs, sigs, _ = _batch(8, corrupt=False)
+    # Overwrite R with the non-canonical encoding of y = p + 1 (sign bit 0).
+    bad_r = int(P + 1).to_bytes(32, "little")
+    sigs = list(sigs)
+    sigs[3] = bad_r + sigs[3][32:]
+    packed = pack_batch(pks, msgs, sigs)
+    host_ok = packed[-1]
+    assert not host_ok[3]
+    assert host_ok[[i for i in range(8) if i != 3]].all()
+    got = np.asarray(EP.verify_pallas(*packed, tile=8, interpret=True))
+    assert not got[3]
+
+
+def test_pallas_rejects_bad_tile():
+    pks, msgs, sigs, _ = _batch(10, corrupt=False)
+    packed = E.pack_batch(pks, msgs, sigs)
+    with pytest.raises(ValueError):
+        EP.verify_pallas(*packed, tile=8, interpret=True)
