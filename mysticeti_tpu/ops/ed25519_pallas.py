@@ -121,14 +121,13 @@ def _normalize_top(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _fold_reduce(wide: jnp.ndarray) -> jnp.ndarray:
-    x = _carry(_carry(_carry(wide)))
-    lo = x[:NLIMBS]
-    hi = x[NLIMBS : 2 * NLIMBS]
-    top = x[2 * NLIMBS :]  # (2, T)
-    lo = lo + FOLD_260 * hi
-    lo = jnp.concatenate(
-        [lo[:2] + FOLD_260 * FOLD_260 * top, lo[2:], jnp.zeros_like(lo[:1])], axis=0
-    )
+    # One carry pass on the wide (42, T) array: diagonal sums < 2^30.4 decay
+    # to limbs <= 2^17.4.  Folding immediately is then safe (608 * 2^17.4 +
+    # 2^17.4 < 2^27) and moves all later carry work onto a cheap 21-limb
+    # workspace instead of the 42-limb one.
+    x = _carry(wide)
+    lo = jnp.concatenate([x[:NLIMBS], jnp.zeros_like(x[:1])], axis=0)  # (21, T)
+    lo = lo + FOLD_260 * x[NLIMBS : 2 * NLIMBS + 1]
     lo = _carry(_carry(lo))
     lo = jnp.concatenate(
         [lo[:1] + FOLD_260 * lo[NLIMBS : NLIMBS + 1], lo[1:NLIMBS]], axis=0
@@ -151,11 +150,16 @@ def fmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def fsq(a: jnp.ndarray) -> jnp.ndarray:
+    # Triangle squaring was measured perf-neutral here (concat overhead eats
+    # the halved product count) — plain schoolbook keeps the code simple.
     return fmul(a, a)
 
 
 def fadd(a, b):
-    return _normalize_top(_carry(a + b))
+    # Lazy add: one signed carry pass, top limb left loose (< 2^11 after the
+    # shallow add chains in the point formulas) — products and fsub's 8p bias
+    # tolerate it, and _fold_reduce restores the tight form after every mul.
+    return _carry(a + b)
 
 
 def fsub(a, b):
@@ -231,8 +235,11 @@ def fparity(a):
 # Point ops (extended twisted-Edwards, a=-1), limb-major
 # ---------------------------------------------------------------------------
 
-def point_add(p: Point, q: Point) -> Point:
-    x1, y1, z1, t1 = p
+def point_add(p: Point, q: Point, want_t: bool = True):
+    """Unified extended addition (add-2008-hwcd-3, a=-1); 9 muls, 8 with
+    ``want_t=False`` (legal when the result only feeds doublings, which never
+    read T)."""
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
     x2, y2, z2, t2 = q
     a = fmul(fsub(y1, x1), fsub(y2, x2))
     b = fmul(fadd(y1, x1), fadd(y2, x2))
@@ -242,11 +249,30 @@ def point_add(p: Point, q: Point) -> Point:
     f = fsub(d, c)
     g = fadd(d, c)
     h = fadd(b, a)
+    out = (fmul(e, f), fmul(g, h), fmul(f, g))
+    return (*out, fmul(e, h)) if want_t else out
+
+
+def point_madd(p: Point, q3) -> Point:
+    """Mixed addition with a Niels-form precomputed point q3 = (y-x, y+x,
+    2d*xy), Z=1 (madd-2008-hwcd): 7 muls.  Used for the fixed-base comb."""
+    x1, y1, z1, t1 = p
+    q_ymx, q_ypx, q_t2d = q3
+    a = fmul(fsub(y1, x1), q_ymx)
+    b = fmul(fadd(y1, x1), q_ypx)
+    c = fmul(t1, q_t2d)
+    d = fadd(z1, z1)
+    e = fsub(b, a)
+    f = fsub(d, c)
+    g = fadd(d, c)
+    h = fadd(b, a)
     return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
 
 
-def point_double(p: Point) -> Point:
-    x1, y1, z1, _ = p
+def point_double(p, want_t: bool = True):
+    """dbl-2008-hwcd: never reads T; emits it only when the next op is an
+    addition (the 4th double of each window group)."""
+    x1, y1, z1 = p[0], p[1], p[2]
     a = fsq(x1)
     b = fsq(y1)
     c = fadd(fsq(z1), fsq(z1))
@@ -254,12 +280,21 @@ def point_double(p: Point) -> Point:
     e = fsub(h, fsq(fadd(x1, y1)))
     g = fsub(a, b)
     f = fadd(c, g)
-    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+    out = (fmul(e, f), fmul(g, h), fmul(f, g))
+    return (*out, fmul(e, h)) if want_t else out
 
 
 def point_neg(p: Point) -> Point:
     x, y, z, t = p
     return (fneg(x), y, z, fneg(t))
+
+
+def _dbl4(p, want_t: bool = True):
+    """Four doublings; T materialized only on the last (if requested)."""
+    p = point_double(p, want_t=False)
+    p = point_double(p, want_t=False)
+    p = point_double(p, want_t=False)
+    return point_double(p, want_t=want_t)
 
 
 def _identity(t: int) -> Point:
@@ -304,10 +339,10 @@ def _gather16(tab: List[Point], idx: jnp.ndarray) -> Point:
     return tuple(coords)
 
 
-def _gather_comb(entry: jnp.ndarray, idx: jnp.ndarray) -> Point:
-    """entry (4, NLIMBS, 16) constant slice; idx (1, T) -> per-item point."""
+def _gather_comb(entry: jnp.ndarray, idx: jnp.ndarray):
+    """entry (3, NLIMBS, 16) Niels-form slice; idx (1, T) -> (ymx, ypx, t2d)."""
     coords = []
-    for c in range(4):
+    for c in range(3):
         acc = None
         for v in range(16):
             m = (idx == v).astype(jnp.int32)  # (1, T)
@@ -317,8 +352,22 @@ def _gather_comb(entry: jnp.ndarray, idx: jnp.ndarray) -> Point:
     return tuple(coords)
 
 
-# Comb table transposed for limb-major gathers: (64, 4, NLIMBS, 16).
-_COMB_T = np.ascontiguousarray(np.transpose(E._build_base_comb(), (0, 2, 3, 1)))
+def _build_niels_comb() -> np.ndarray:
+    """(64, 3, NLIMBS, 16): the fixed-base comb in Niels form (y-x, y+x,
+    2d*xy mod p), one 16-entry table per 4-bit window of s (v * 16^w * B)."""
+    raw = E._build_base_comb()  # (64, 16, 4, 20) extended (X, Y, Z=1, T)
+    out = np.zeros((64, 3, NLIMBS, 16), np.int32)
+    for w in range(64):
+        for v in range(16):
+            x = F.limbs_to_int(raw[w, v, 0])
+            y = F.limbs_to_int(raw[w, v, 1])
+            out[w, 0, :, v] = F.int_to_limbs((y - x) % F.P)
+            out[w, 1, :, v] = F.int_to_limbs((y + x) % F.P)
+            out[w, 2, :, v] = F.int_to_limbs(2 * E._D * x * y % F.P)
+    return out
+
+
+_COMB_T = _build_niels_comb()
 
 
 # ---------------------------------------------------------------------------
@@ -351,19 +400,24 @@ def _verify_body(
         tab.append(point_add(tab[v - 1], neg_a))
 
     def step(i, carry):
-        acc_a = carry[:4]
-        acc_b = carry[4:]
-        for _ in range(4):
-            acc_a = point_double(acc_a)
+        acc_a = carry[:3]  # X, Y, Z only — T is dead between window groups
+        acc_b = carry[3:]
+        acc_a = _dbl4(acc_a)
         kw = k_w_ref[pl.ds(63 - i, 1), :]  # ladder consumes MSB window first
-        acc_a = point_add(acc_a, _gather16(tab, kw))
+        acc_a = point_add(acc_a, _gather16(tab, kw), want_t=False)
         sw = s_w_ref[pl.ds(i, 1), :]
-        entry = comb_ref[i]  # (4, NLIMBS, 16)
-        acc_b = point_add(acc_b, _gather_comb(entry, sw))
+        entry = comb_ref[i]  # (3, NLIMBS, 16) Niels form
+        acc_b = point_madd(acc_b, _gather_comb(entry, sw))
         return (*acc_a, *acc_b)
 
-    carry = jax.lax.fori_loop(0, 64, step, (*ident, *ident))
-    res = point_add(carry[:4], carry[4:])
+    carry = jax.lax.fori_loop(0, 63, step, (*ident[:3], *ident))
+    # Peeled last window: the final adds must materialize T for the combine.
+    acc_a = _dbl4(carry[:3])
+    acc_a = point_add(acc_a, _gather16(tab, k_w_ref[pl.ds(0, 1), :]))
+    acc_b = point_madd(
+        carry[3:], _gather_comb(comb_ref[63], s_w_ref[pl.ds(63, 1), :])
+    )
+    res = point_add(acc_a, acc_b)
 
     x, y, z, _ = res
     zinv = finv(z)
