@@ -423,7 +423,8 @@ def _verify_body(
     zinv = finv(z)
     x_aff = fmul(x, zinv)
     y_aff = fmul(y, zinv)
-    # r_y arrives canonical (host rejects y >= p): memcmp-equivalent compare.
+    # Exact compare on the raw R limbs (memcmp semantics): a non-canonical R
+    # (y >= p) can never equal fcanonical output, so it is rejected.
     match = feq(y_aff, r_y_ref[...]) & (fparity(x_aff) == r_sign_ref[...])
     ok = match & dec_ok & (host_ok_ref[...] != 0)
     out_ref[...] = ok.astype(jnp.int32)
@@ -470,6 +471,66 @@ def _verify_pallas_jit(
         host_ok[None, :].astype(jnp.int32),
     )
     return out[0].astype(bool)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_fused_pallas_jit(msg_words, s_words, host_ok, *, tile, interpret):
+    # Parse/hash/reduce in XLA (cheap, fuses well), ladder in Pallas (VMEM).
+    a_y, a_sign, r_y, r_sign, s_w, k_w, ok = E.prepare_fused(
+        msg_words, s_words, host_ok
+    )
+    return _verify_pallas_jit(
+        a_y, a_sign, r_y, r_sign, s_w, k_w, ok, tile=tile, interpret=interpret
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_fused_blob_pallas_jit(blob, *, tile, interpret):
+    args = E.prepare_fused(blob[..., :24], blob[..., 24:32], blob[..., 32] != 0)
+    return _verify_pallas_jit(*args, tile=tile, interpret=interpret)
+
+
+def verify_fused_blob_pallas(
+    blob, *, tile: Optional[int] = None, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Single-array fused verification (ops.ed25519.pack_blob layout): one
+    host->device transfer per batch, parse/hash in XLA, ladder in Pallas."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile is None:
+        tile = default_tile()
+    b = blob.shape[0]
+    if b % tile != 0:
+        raise ValueError(f"batch {b} not a multiple of tile {tile}")
+    return _verify_fused_blob_pallas_jit(
+        jnp.asarray(blob), tile=tile, interpret=interpret
+    )
+
+
+def verify_fused_pallas(
+    msg_words,
+    s_words,
+    host_ok,
+    *,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused raw-bytes verification with the Pallas ladder: device SHA-512 +
+    mod-L + parsing (ops.ed25519.prepare_fused) feeding the VMEM kernel."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile is None:
+        tile = default_tile()
+    b = msg_words.shape[0]
+    if b % tile != 0:
+        raise ValueError(f"batch {b} not a multiple of tile {tile}")
+    return _verify_fused_pallas_jit(
+        jnp.asarray(msg_words),
+        jnp.asarray(s_words),
+        jnp.asarray(host_ok),
+        tile=tile,
+        interpret=interpret,
+    )
 
 
 def default_tile() -> int:

@@ -1,0 +1,222 @@
+"""Scalar arithmetic mod the Ed25519 group order L — on device, in 13-bit limbs.
+
+This closes the last host/device gap in the verification pipeline: the
+challenge scalar k = SHA-512(R || A || M) mod L was previously computed per
+item on the host (hashlib + python ints, ``crypto.rs:174-189`` territory).
+With :mod:`mysticeti_tpu.ops.sha512` producing the 512-bit digest on device,
+this module reduces it mod L and slices it into the 4-bit ladder windows that
+:func:`mysticeti_tpu.ops.ed25519.verify_impl` consumes — so raw signature
+bytes go in and verification bits come out with zero per-item host work.
+
+Design notes (TPU-first, not a port of ref10's sc_reduce):
+
+* Same 13-bit limb radix as :mod:`mysticeti_tpu.ops.field` — products of
+  carried limbs fit int32 with headroom for 20-term diagonal sums.
+* L = 2^252 + d with d ~ 2^124.6, so 2^260 = -256*d (mod L): the 512-bit
+  digest folds down via three *signed* multiply-by-256d passes (magnitudes
+  shrink 2^520 -> 2^394 -> 2^268 -> ~2^260), then a bias of 1024*L makes the
+  value positive, a single Barrett-style quotient q = floor(x / 2^252) < 2^11
+  removes the top bits (x == r - q*d mod L), and one conditional subtract of L
+  canonicalizes.  All passes are static vector ops over the batch — no
+  data-dependent control flow, vmap/jit-safe.
+* Carry propagation is a handful of vectorized passes (see field.py's module
+  docstring); full normalization uses a ``fori_loop`` of width+2 passes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+RADIX = 13
+MASK = (1 << RADIX) - 1
+
+L = (1 << 252) + 27742317777372353535851937790883648493
+_DELTA = L - (1 << 252)  # 125 bits
+_D256 = _DELTA << 8  # 2^260 mod L == -_D256; 133 bits -> 11 limbs
+P = (1 << 255) - 19
+
+
+def _int_to_limbs_np(x: int, n: int) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0, "constant exceeds limb capacity"
+    return out
+
+
+_D256_LIMBS = _int_to_limbs_np(_D256, 11)
+_DELTA_LIMBS = _int_to_limbs_np(_DELTA, 10)
+_L_LIMBS = jnp.asarray(_int_to_limbs_np(L, 20))
+_L1024_LIMBS = jnp.asarray(_int_to_limbs_np(1024 * L, 21))
+_P_LIMBS_20 = _int_to_limbs_np(P, 20)
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side debugging helper: limb vector -> python int."""
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(np.asarray(limbs).tolist()))
+
+
+def _carry_once(x: jnp.ndarray) -> jnp.ndarray:
+    """One signed carry pass.  The TOP limb is left raw (it carries the sign
+    of the whole value); normalizing it would turn a -1 into 8191 and silently
+    drop the borrow.  Callers size workspaces so the top limb stays small."""
+    c = x >> RADIX
+    c = c.at[..., -1].set(0)
+    x = x - (c << RADIX)
+    return x + jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+
+
+def _full_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Worst-case borrow/carry ripple: width+2 passes under fori_loop."""
+    n = x.shape[-1]
+    return jax.lax.fori_loop(0, n + 2, lambda _, v: _carry_once(v), x)
+
+
+def _pad_limbs(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    pad = width - x.shape[-1]
+    if pad <= 0:
+        return x[..., :width]
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+
+
+def _mul_const(x: jnp.ndarray, const_limbs: np.ndarray) -> jnp.ndarray:
+    """x (..., n) signed carried limbs times a small nonneg constant limb
+    vector; returns (..., n + len(const)) UNCARRIED product (|sums| < 2^30)."""
+    n = x.shape[-1]
+    m = len(const_limbs)
+    out_w = n + m
+    acc = None
+    for j in range(m):
+        cj = int(const_limbs[j])
+        if cj == 0:
+            continue
+        term = x * cj
+        padded = jnp.pad(term, [(0, 0)] * (x.ndim - 1) + [(j, out_w - n - j)])
+        acc = padded if acc is None else acc + padded
+    if acc is None:
+        acc = jnp.zeros((*x.shape[:-1], out_w), x.dtype)
+    return acc
+
+
+def _fold_once(x: jnp.ndarray, out_width: int) -> jnp.ndarray:
+    """One signed fold: value(x) == lo + 2^260*hi -> lo - 256d*hi (mod L)."""
+    lo = x[..., :20]
+    hi = x[..., 20:]
+    prod = _mul_const(hi, _D256_LIMBS)
+    res = _pad_limbs(lo, out_width) - _pad_limbs(prod, out_width)
+    return _carry_once(_carry_once(_carry_once(res)))
+
+
+def mod_L(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a 512-bit value given as (..., 40) carried limbs mod L.
+
+    Returns (..., 20) int32 canonical limbs of the value in [0, L).
+    """
+    # Signed folds: 40 -> 32 -> 24 -> 22 limbs; |value| ends < 2^261.  Each
+    # output width leaves TWO limbs above the highest nonzero raw product
+    # term: pass-1 carries (up to 2^17) land one limb up and pass-2 carries
+    # one more — with both spares present nothing is ever dropped.
+    x = _fold_once(x, 32)
+    x = _fold_once(x, 24)
+    x = _fold_once(x, 22)
+    # Bias positive (+1024L ~ 2^262) and fully normalize to unique limbs.
+    x = _pad_limbs(x, 22) + _pad_limbs(_L1024_LIMBS, 22)
+    x = _full_carry(x)
+    # Barrett step: x == q*2^252 + r, 2^252 == -d (mod L)  =>  x == r - q*d.
+    q = (x[..., 19] >> 5) + (x[..., 20] << 8) + (x[..., 21] << 21)  # < 2^11
+    r = x[..., :20].at[..., 19].set(x[..., 19] & 31)
+    # q*d < 2^136 needs 11 limbs; pad before carrying so the carry out of
+    # limb 9 (up to 2^11) is not dropped.
+    qd = _pad_limbs(q[..., None] * jnp.asarray(_DELTA_LIMBS), 11)
+    qd = _carry_once(_carry_once(qd))
+    y = r + _L_LIMBS - _pad_limbs(qd, 20)  # in (0, 2L)
+    y = _full_carry(y)
+    # One conditional subtract of L finishes canonicalization.
+    ge = geq_const(y, _int_to_limbs_np(L, 20))
+    y = jnp.where(ge[..., None], y - _L_LIMBS, y)
+    return _full_carry(y)
+
+
+# ---------------------------------------------------------------------------
+# Byte/word plumbing
+# ---------------------------------------------------------------------------
+
+
+def bswap32(x: jnp.ndarray) -> jnp.ndarray:
+    """Byte-swap uint32 lanes (big-endian word <-> little-endian word)."""
+    x = x.astype(jnp.uint32)
+    return (
+        ((x & 0xFF) << 24)
+        | ((x & 0xFF00) << 8)
+        | ((x >> 8) & 0xFF00)
+        | (x >> 24)
+    )
+
+
+def digest_words_to_le(digest: jnp.ndarray) -> jnp.ndarray:
+    """(..., 16) sha512_96 output ([hi0, lo0, ..]) -> (..., 16) uint32 words
+    v_j of the digest interpreted as a little-endian integer (sum v_j 2^32j).
+
+    The digest byte stream is the big-endian encoding of each 64-bit word in
+    order; little-endian 32-bit value words are therefore just the byte-swap
+    of the output words in place.
+    """
+    return bswap32(digest)
+
+
+def words_to_limbs(words: jnp.ndarray, n_limbs: int) -> jnp.ndarray:
+    """(..., W) uint32 little-endian value words -> (..., n_limbs) int32 limbs."""
+    w = words.shape[-1]
+    words = words.astype(jnp.uint32)
+    out = []
+    for m in range(n_limbs):
+        bit = RADIX * m
+        q, r = bit // 32, bit % 32
+        if q >= w:
+            out.append(jnp.zeros_like(words[..., 0]))
+            continue
+        v = words[..., q] >> r
+        if r + RADIX > 32 and q + 1 < w:
+            v = v | (words[..., q + 1] << (32 - r))
+        out.append(v & MASK)
+    return jnp.stack(out, axis=-1).astype(jnp.int32)
+
+
+def windows4(limbs: jnp.ndarray, n_windows: int = 64) -> jnp.ndarray:
+    """(..., 20) canonical limbs -> (..., n_windows) 4-bit windows, LSB first
+    (the layout ``ed25519._double_scalar_mul`` consumes)."""
+    out = []
+    for wnd in range(n_windows):
+        bit = 4 * wnd
+        q, r = bit // RADIX, bit % RADIX
+        v = limbs[..., q] >> r
+        if r + 4 > RADIX and q + 1 < limbs.shape[-1]:
+            v = v | (limbs[..., q + 1] << (RADIX - r))
+        out.append(v & 15)
+    return jnp.stack(out, axis=-1).astype(jnp.int32)
+
+
+def geq_const(limbs: jnp.ndarray, const_limbs: np.ndarray) -> jnp.ndarray:
+    """Lexicographic (value) compare of unique nonneg limb arrays against a
+    constant: returns batch-shaped bool, True iff value(limbs) >= const."""
+    n = limbs.shape[-1]
+    ge = jnp.zeros(limbs.shape[:-1], bool)
+    eq = jnp.ones(limbs.shape[:-1], bool)
+    for i in reversed(range(n)):
+        c = int(const_limbs[i]) if i < len(const_limbs) else 0
+        ge = ge | (eq & (limbs[..., i] > c))
+        eq = eq & (limbs[..., i] == c)
+    return ge | eq
+
+
+def lt_L(limbs: jnp.ndarray) -> jnp.ndarray:
+    """value(limbs) < L (for s-canonicity: RFC 8032 / OpenSSL reject s >= L)."""
+    return ~geq_const(limbs, _int_to_limbs_np(L, limbs.shape[-1]))
+
+
+def lt_P(limbs: jnp.ndarray) -> jnp.ndarray:
+    """value(limbs) < p (canonical field-element encoding check)."""
+    return ~geq_const(limbs, _int_to_limbs_np(P, limbs.shape[-1]))

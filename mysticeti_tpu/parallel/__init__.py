@@ -8,6 +8,16 @@ sharded across the chips of a pod slice with ``shard_map`` — pure data
 parallelism over the batch axis, plus an ICI ``psum`` for the aggregate
 valid-count that the vote tally consumes.
 """
-from .mesh import make_mesh, sharded_verify_kernel, sharded_verify_batch
+from .mesh import (
+    make_mesh,
+    sharded_verify_kernel,
+    sharded_verify_batch,
+    sharded_verify_batch_fused,
+)
 
-__all__ = ["make_mesh", "sharded_verify_kernel", "sharded_verify_batch"]
+__all__ = [
+    "make_mesh",
+    "sharded_verify_kernel",
+    "sharded_verify_batch",
+    "sharded_verify_batch_fused",
+]

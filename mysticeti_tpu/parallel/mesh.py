@@ -18,8 +18,23 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+
+    _REP_KW = "check_vma"
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _REP_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    # The replication-check kwarg was renamed check_rep -> check_vma; we
+    # disable it either way (the psum'd total is intentionally replicated).
+    kwargs[_REP_KW] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)
 
 from ..ops import ed25519 as E
 
@@ -75,3 +90,84 @@ def sharded_verify_batch(
         arrs.append(jnp.asarray(np.pad(x, widths)))
     ok, total = kernel(*arrs)
     return np.asarray(ok)[:n], int(total)
+
+
+# One compiled kernel per (mesh, flavor) — rebuilding the shard_map wrapper on
+# every dispatch would recompile each time.
+_KERNEL_CACHE: dict = {}
+
+
+def _cached_fused_kernel(mesh: Mesh):
+    backend = E._backend()
+    key = ("fused", mesh, backend)
+    if key not in _KERNEL_CACHE:
+        spec = PSpec("batch")
+
+        def _shard_body(msg_words, s_words, host_ok):
+            if backend == "pallas":
+                # Same Pallas ladder as the single-chip path, one grid per
+                # shard; the tile shrinks if a shard is narrower than 256.
+                from ..ops import ed25519_pallas as PK
+
+                per_shard = msg_words.shape[0]
+                args = E.prepare_fused(msg_words, s_words, host_ok)
+                ok = PK._verify_pallas_jit(
+                    *args,
+                    tile=min(PK.default_tile(), per_shard),
+                    interpret=False,
+                )
+            else:
+                ok = E.verify_fused_impl(msg_words, s_words, host_ok)
+            total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), "batch")
+            return ok, total
+
+        _KERNEL_CACHE[key] = jax.jit(
+            shard_map(
+                _shard_body,
+                mesh=mesh,
+                in_specs=(spec,) * 3,
+                out_specs=(spec, PSpec()),
+                check_rep=False,
+            )
+        )
+    return _KERNEL_CACHE[key]
+
+
+def sharded_verify_batch_fused(
+    mesh: Mesh,
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> Tuple[np.ndarray, int]:
+    """Fused raw-bytes verification sharded over the mesh batch axis.
+
+    Uses the fixed bucket shapes of :mod:`..ops.ed25519` (all divisible by
+    any power-of-two mesh up to 256 devices) so XLA compiles at most
+    len(BUCKETS) shard programs per mesh.  Returns (per-item bool, global
+    valid count via ICI psum).
+    """
+    n = len(signatures)
+    if n == 0:
+        return np.zeros(0, bool), 0
+    kernel = _cached_fused_kernel(mesh)
+    msg_words, s_words, host_ok = E.pack_bytes(public_keys, messages, signatures)
+    # Dispatch every chunk asynchronously, force once at the end — same
+    # overlap policy as ops.ed25519.dispatch_blob_chunks.
+    handles = [
+        (
+            start,
+            count,
+            kernel(
+                jnp.asarray(E._pad_to(msg_words[start : start + count], b)),
+                jnp.asarray(E._pad_to(s_words[start : start + count], b)),
+                jnp.asarray(E._pad_to(host_ok[start : start + count], b)),
+            ),
+        )
+        for start, count, b in E.iter_buckets(n)
+    ]
+    out = np.empty(n, bool)
+    total = 0
+    for start, count, (ok, tot) in handles:
+        out[start : start + count] = np.asarray(ok)[:count]
+        total += int(tot)
+    return out, total

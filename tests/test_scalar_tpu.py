@@ -1,0 +1,85 @@
+"""Parity tests for device scalar arithmetic mod L (ops/scalar.py).
+
+Every function is checked against plain python-int arithmetic — the same
+oracle discipline as the field/curve kernels (SURVEY §4 tier "crypto-parity").
+"""
+import hashlib
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from mysticeti_tpu.ops import scalar as S
+from mysticeti_tpu.ops import sha512 as H
+
+
+def _limbs_from_int(x: int, n: int) -> np.ndarray:
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        out[i] = x & S.MASK
+        x >>= S.RADIX
+    assert x == 0
+    return out
+
+
+def test_mod_l_random_512bit():
+    rng = random.Random(1)
+    vals = [rng.getrandbits(512) for _ in range(64)]
+    # Adversarial corners: 0, 1, L-1, L, L+1, multiples of L, all-ones.
+    vals += [0, 1, S.L - 1, S.L, S.L + 1, 2**512 - 1, 17 * S.L, S.L << 252]
+    arr = np.stack([_limbs_from_int(v, 40) for v in vals])
+    got = np.asarray(S.mod_L(jnp.asarray(arr)))
+    for v, limbs in zip(vals, got):
+        assert S.limbs_to_int(limbs) == v % S.L
+        assert all(0 <= int(l) <= S.MASK for l in limbs)
+
+
+def test_mod_l_matches_sha512_challenge():
+    """End-to-end: sha512_96 digest -> LE words -> limbs -> mod L equals
+    int.from_bytes(hashlib.sha512(m).digest(), 'little') % L."""
+    rng = random.Random(2)
+    msgs = [bytes(rng.randrange(256) for _ in range(96)) for _ in range(16)]
+    words = jnp.asarray(H.pack_messages(msgs))
+    digests = H.sha512_96(words)
+    le = S.digest_words_to_le(digests)
+    k = np.asarray(S.mod_L(S.words_to_limbs(le, 40)))
+    for m, limbs in zip(msgs, k):
+        want = int.from_bytes(hashlib.sha512(m).digest(), "little") % S.L
+        assert S.limbs_to_int(limbs) == want
+
+
+def test_words_to_limbs_roundtrip():
+    rng = random.Random(3)
+    vals = [rng.getrandbits(256) for _ in range(32)] + [0, 2**256 - 1]
+    words = np.stack(
+        [np.frombuffer(v.to_bytes(32, "little"), dtype="<u4") for v in vals]
+    ).astype(np.uint32)
+    limbs = np.asarray(S.words_to_limbs(jnp.asarray(words), 20))
+    for v, row in zip(vals, limbs):
+        assert S.limbs_to_int(row) == v
+
+
+def test_windows4_matches_bit_slices():
+    rng = random.Random(4)
+    vals = [rng.getrandbits(253) for _ in range(16)] + [0, S.L - 1]
+    arr = np.stack([_limbs_from_int(v, 20) for v in vals])
+    wins = np.asarray(S.windows4(jnp.asarray(arr)))
+    for v, row in zip(vals, wins):
+        for w in range(64):
+            assert int(row[w]) == (v >> (4 * w)) & 15
+
+
+def test_lt_checks():
+    vals = [0, 1, S.L - 1, S.L, S.L + 1, S.P - 1, S.P, S.P + 1, 2**255 - 1]
+    arr = np.stack([_limbs_from_int(v, 20) for v in vals])
+    lt_l = np.asarray(S.lt_L(jnp.asarray(arr)))
+    lt_p = np.asarray(S.lt_P(jnp.asarray(arr)))
+    for v, a, b in zip(vals, lt_l, lt_p):
+        assert bool(a) == (v < S.L)
+        assert bool(b) == (v < S.P)
+
+
+def test_bswap32():
+    x = jnp.asarray(np.array([0x01020304, 0xDEADBEEF, 0, 0xFFFFFFFF], np.uint32))
+    got = np.asarray(S.bswap32(x))
+    assert list(got) == [0x04030201, 0xEFBEADDE, 0, 0xFFFFFFFF]
