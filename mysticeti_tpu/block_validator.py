@@ -76,9 +76,43 @@ class CpuSignatureVerifier(SignatureVerifier):
 
 
 class TpuSignatureVerifier(SignatureVerifier):
-    """The JAX kernel (ops/ed25519.py), dispatched on the default device."""
+    """The JAX kernel (ops/ed25519.py) — fused raw-bytes path.
+
+    ``mesh="auto"`` shards the batch over all local devices via ``shard_map``
+    (parallel/mesh.py) when more than one is attached; a single chip (or CPU)
+    dispatches the plain bucketed kernel.  Pass an explicit
+    ``jax.sharding.Mesh`` or ``None`` to override.
+    """
+
+    def __init__(self, mesh="auto") -> None:
+        self._mesh = mesh
+
+    def _resolve_mesh(self):
+        if self._mesh == "auto":
+            import jax
+
+            from .parallel.mesh import make_mesh
+
+            # Clamp to the largest power-of-two prefix: the fused bucket
+            # shapes (256/1024/4096) shard evenly only over power-of-two
+            # meshes, and TPU slices are power-of-two sized anyway.
+            n = len(jax.devices())
+            pow2 = 1 << (n.bit_length() - 1)
+            self._mesh = make_mesh(pow2) if pow2 > 1 else None
+        return self._mesh
 
     def verify_signatures(self, public_keys, digests, signatures):
+        mesh = self._resolve_mesh()
+        # The fused sharded kernel requires 32-byte messages (block digests);
+        # other lengths fall back to the single-device host-hash path so the
+        # result never depends on the device count.
+        if mesh is not None and all(len(d) == 32 for d in digests):
+            from .parallel.mesh import sharded_verify_batch_fused
+
+            ok, _ = sharded_verify_batch_fused(
+                mesh, public_keys, digests, signatures
+            )
+            return list(ok)
         from .ops import ed25519
 
         return list(ed25519.verify_batch(public_keys, digests, signatures))
@@ -103,11 +137,13 @@ class BatchedSignatureVerifier(BlockVerifier):
         verifier: Optional[SignatureVerifier] = None,
         max_batch: int = 256,
         max_delay_s: float = 0.005,
+        metrics=None,
     ) -> None:
         self.committee = committee
         self.verifier = verifier or TpuSignatureVerifier()
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
+        self.metrics = metrics
         self._pending: List[Tuple[StatementBlock, asyncio.Future]] = []
         self._lock = threading.Lock()
         self._flush_task: Optional[asyncio.TimerHandle] = None
@@ -146,9 +182,30 @@ class BatchedSignatureVerifier(BlockVerifier):
         digests = [b.signed_digest() for b in blocks]
         sigs = [b.signature for b in blocks]
         loop = asyncio.get_running_loop()
-        results = await loop.run_in_executor(
-            None, self.verifier.verify_signatures, pks, digests, sigs
-        )
+        try:
+            results = await loop.run_in_executor(
+                None, self.verifier.verify_signatures, pks, digests, sigs
+            )
+        except Exception as exc:
+            # A JAX runtime/compile failure must not strand the awaiting
+            # connection tasks forever — fail every future in the batch.
+            for _, future in batch:
+                if not future.done():
+                    future.set_exception(
+                        VerificationError(f"signature verifier crashed: {exc!r}")
+                    )
+            return
+        if self.metrics is not None:
+            self.metrics.verify_batch_size.observe(len(batch))
+            backend = type(self.verifier).__name__
+            accepted = sum(bool(ok) for ok in results)
+            self.metrics.verified_signatures_total.labels(backend, "accepted").inc(
+                accepted
+            )
+            if accepted < len(batch):
+                self.metrics.verified_signatures_total.labels(
+                    backend, "rejected"
+                ).inc(len(batch) - accepted)
         for (_, future), ok in zip(batch, results):
             if not future.done():
                 future.set_result(bool(ok))

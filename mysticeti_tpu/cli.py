@@ -61,7 +61,7 @@ async def run_node(
     committee_path: str,
     parameters_path: str,
     private_dir: str,
-    verifier: str = "accept",
+    verifier: str = "cpu",
     tps: Optional[int] = None,
 ) -> None:
     """main.rs:159-185."""
@@ -84,7 +84,7 @@ async def run_node(
 
 
 async def testbed(committee_size: int, working_dir: str, duration_s: float,
-                  verifier: str = "accept") -> List:
+                  verifier: str = "cpu") -> List:
     """N in-process validators on localhost (main.rs:187-227)."""
     ips = ["127.0.0.1"] * committee_size
     benchmark_genesis(ips, working_dir)
@@ -115,6 +115,9 @@ async def testbed(committee_size: int, working_dir: str, duration_s: float,
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from .tracing import setup_logging
+
+    setup_logging()  # honors MYSTICETI_LOG (RUST_LOG-style env filter)
     parser = argparse.ArgumentParser(prog="mysticeti-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -127,19 +130,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     r.add_argument("--committee-path", required=True)
     r.add_argument("--parameters-path", required=True)
     r.add_argument("--private-config-path", required=True)
-    r.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="accept")
+    r.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
 
     d = sub.add_parser("dry-run", help="one validator of an N-node local setup")
     d.add_argument("--committee-size", type=int, required=True)
     d.add_argument("--authority", type=int, required=True)
     d.add_argument("--working-directory", default="dryrun")
-    d.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="accept")
+    d.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
 
     t = sub.add_parser("testbed", help="N in-process validators")
     t.add_argument("--committee-size", type=int, required=True)
     t.add_argument("--working-directory", default="testbed")
     t.add_argument("--duration", type=float, default=30.0)
-    t.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="accept")
+    t.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
 
     args = parser.parse_args(argv)
 

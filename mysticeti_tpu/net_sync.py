@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Set
 
 from .block_validator import AcceptAllBlockVerifier, BlockVerifier
 from .commit_observer import CommitObserver
-from .config import Parameters
+from .config import Parameters, ROUNDS_IN_EPOCH_MAX
 from .core import Core
 from .core_task import CoreTaskDispatcher
 from .network import (
@@ -34,33 +34,52 @@ from .network import (
     SubscribeOwnFrom,
 )
 from .syncer import Syncer, SyncerSignals
+from .tracing import logger
+
+log = logger(__name__)
 from .synchronizer import BlockDisseminator, BlockFetcher
 from .types import AuthoritySet, StatementBlock, VerificationError
 
 CLEANUP_INTERVAL_S = 10.0
 
 
+class Notify:
+    """Lost-wakeup-free notification (the tokio ``Notify::notified`` shape).
+
+    ``subscribe()`` hands out the CURRENT event object; ``notify()`` sets it
+    and installs a fresh one.  A consumer that subscribes BEFORE checking its
+    condition can never miss a notification that follows the check — unlike
+    the set-then-``call_soon``-clear Event pattern, where a task awaiting
+    between set and clear lost the edge.
+    """
+
+    __slots__ = ("_event",)
+
+    def __init__(self) -> None:
+        self._event = asyncio.Event()
+
+    def subscribe(self) -> asyncio.Event:
+        return self._event
+
+    def notify(self) -> None:
+        event, self._event = self._event, asyncio.Event()
+        event.set()
+
+
 class AsyncSignals(SyncerSignals):
     """Signals backed by asyncio primitives (syncer.rs:24-52)."""
 
     def __init__(self) -> None:
-        self.block_ready = asyncio.Event()
-        self.round_advanced = asyncio.Condition()
+        self.block_ready = Notify()
+        self.round_notify = Notify()
         self.current_round = 0
 
     def new_block_ready(self) -> None:
-        self.block_ready.set()
-        # Re-arm on the next loop tick so stream tasks level-trigger.
-        asyncio.get_event_loop().call_soon(self.block_ready.clear)
+        self.block_ready.notify()
 
     def new_round(self, round_: int) -> None:
         self.current_round = round_
-
-        async def notify():
-            async with self.round_advanced:
-                self.round_advanced.notify_all()
-
-        asyncio.ensure_future(notify())
+        self.round_notify.notify()
 
 
 class NetworkSyncer:
@@ -113,6 +132,8 @@ class NetworkSyncer:
         self._tasks.append(asyncio.ensure_future(self._accept_loop()))
         self._tasks.append(asyncio.ensure_future(self._leader_timeout_task()))
         self._tasks.append(asyncio.ensure_future(self._cleanup_task()))
+        if self.parameters.rounds_in_epoch < ROUNDS_IN_EPOCH_MAX:
+            self._tasks.append(asyncio.ensure_future(self._epoch_watch_task()))
         self.fetcher.start()
         if self._start_wal_sync_thread:
             self._start_wal_syncer()
@@ -144,7 +165,8 @@ class NetworkSyncer:
         for d in self._disseminators.values():
             d.stop()
         for t in self._tasks:
-            t.cancel()
+            if t is not asyncio.current_task():
+                t.cancel()
         self.dispatcher.stop()
         for c in self.connections.values():
             c.close()
@@ -166,6 +188,7 @@ class NetworkSyncer:
     async def _connection_task(self, connection: Connection) -> None:
         """net_sync.rs:237-312."""
         peer = connection.peer
+        log.debug("connection established with authority %d", peer)
         self.connections[peer] = connection
         self.connected_authorities.insert(peer)
         disseminator = BlockDisseminator(
@@ -187,17 +210,18 @@ class NetworkSyncer:
                 if isinstance(msg, SubscribeOwnFrom):
                     disseminator.subscribe_own_from(msg.round)
                 elif isinstance(msg, Blocks):
-                    await self._process_blocks(msg.blocks)
+                    await self._process_blocks(msg.blocks, connection)
                 elif isinstance(msg, RequestBlocks):
                     await disseminator.send_requested(list(msg.references))
                 elif isinstance(msg, RequestBlocksResponse):
-                    await self._process_blocks(msg.blocks)
+                    await self._process_blocks(msg.blocks, connection)
                 elif isinstance(msg, BlockNotFound):
                     if self.metrics is not None:
                         self.metrics.block_sync_requests_failed.inc(
                             len(msg.references)
                         )
         finally:
+            log.debug("connection to authority %d closed", peer)
             disseminator.stop()
             self._disseminators.pop(peer, None)
             if self.connections.get(peer) is connection:
@@ -206,12 +230,13 @@ class NetworkSyncer:
 
     # -- the receive pipeline (net_sync.rs:314-386) --
 
-    async def _process_blocks(self, serialized_blocks) -> None:
+    async def _process_blocks(self, serialized_blocks, origin=None) -> None:
         blocks: List[StatementBlock] = []
         for raw in serialized_blocks:
             try:
                 block = StatementBlock.from_bytes(raw)
             except Exception:
+                log.warning("dropping malformed block bytes from peer")
                 continue  # malformed: drop (byzantine peer)
             blocks.append(block)
         if not blocks:
@@ -223,7 +248,8 @@ class NetworkSyncer:
         for block in fresh:
             try:
                 block.verify_structure(self.core.committee)
-            except VerificationError:
+            except VerificationError as exc:
+                log.warning("rejecting block %r: %s", block.reference, exc)
                 continue
             verified.append(block)
         if not verified:
@@ -232,16 +258,31 @@ class NetworkSyncer:
         # (batched across connections on TPU).
         results = await self.block_verifier.verify_blocks(verified)
         accepted = [b for b, ok in zip(verified, results) if ok]
+        if len(accepted) < len(verified):
+            log.warning(
+                "block verifier rejected %d of %d blocks",
+                len(verified) - len(accepted),
+                len(verified),
+            )
         if not accepted:
             return
         missing = await self.dispatcher.add_blocks(
             accepted, self.connected_authorities.copy()
         )
         if missing:
-            # Request missing causal history from whoever sent us the children.
-            for peer, conn in list(self.connections.items()):
-                conn.try_send(RequestBlocks(tuple(missing[:50])))
-                break
+            # Request missing causal history from the connection that
+            # delivered the children — it is the peer most likely to have the
+            # parents (net_sync.rs:276,388-399).  If that connection is stale
+            # (replaced after a reconnect) or the send fails, fall back to any
+            # live peer so the request is never silently dropped.
+            request = RequestBlocks(tuple(missing[:50]))
+            sent = False
+            if origin is not None and self.connections.get(origin.peer) is origin:
+                sent = origin.try_send(request)
+            if not sent:
+                for peer, conn in list(self.connections.items()):
+                    if conn.try_send(request):
+                        break
 
     # -- background tasks --
 
@@ -249,18 +290,34 @@ class NetworkSyncer:
         """net_sync.rs:401-444: force a proposal if the round stalls."""
         timeout = self.parameters.leader_timeout_s
         while True:
+            waiter = self.signals.round_notify.subscribe()
             round_at_start = self.signals.current_round
             try:
-                async with self.signals.round_advanced:
-                    await asyncio.wait_for(
-                        self.signals.round_advanced.wait(), timeout=timeout
-                    )
+                await asyncio.wait_for(waiter.wait(), timeout=timeout)
             except asyncio.TimeoutError:
                 if self.core.epoch_closed():
                     continue
+                log.debug(
+                    "leader timeout at round %d: forcing proposal", round_at_start
+                )
                 await self.dispatcher.force_new_block(
                     round_at_start + 1, self.connected_authorities.copy()
                 )
+
+    async def _epoch_watch_task(self) -> None:
+        """Epoch-aware shutdown (net_sync.rs:466-494): once the epoch is SAFE
+        TO CLOSE, keep serving for the grace period (so slower peers can reach
+        the epoch-close quorum from our blocks), then stop the node."""
+        while not self.core.epoch_closed():
+            await asyncio.sleep(0.2)
+        grace = self.parameters.shutdown_grace_period_s
+        log.info(
+            "epoch safe to close at round %d; shutting down after %.1fs grace",
+            self.signals.current_round,
+            grace,
+        )
+        await asyncio.sleep(grace)
+        await self.stop()
 
     async def _cleanup_task(self) -> None:
         while True:

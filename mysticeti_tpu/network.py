@@ -25,7 +25,10 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .serde import Reader, SerdeError, Writer
+from .tracing import logger
 from .types import BlockReference, RoundNumber, StatementBlock
+
+log = logger(__name__)
 
 HANDSHAKE_MAGIC = 0x7C9A_11B7
 MAX_FRAME = 16 * 1024 * 1024
@@ -282,10 +285,11 @@ class TcpNetwork:
                 ):
                     raise ConnectionError("bad handshake ack")
                 delay = 0.1
+                log.debug("dialed authority %d", peer)
                 await self._run_peer(peer, reader, writer)
             except (OSError, asyncio.IncompleteReadError, ConnectionError, SerdeError,
-                    asyncio.TimeoutError):
-                pass
+                    asyncio.TimeoutError) as exc:
+                log.debug("dial to authority %d failed: %r (retrying)", peer, exc)
             await asyncio.sleep(delay)
             delay = min(delay * 2, 5.0)
 
@@ -309,6 +313,10 @@ class TcpNetwork:
                     if self.metrics is not None:
                         self.metrics.connection_latency.labels(str(peer)).observe(rtt)
                     if rtt >= self.max_latency_s:
+                        log.warning(
+                            "latency breaker: authority %d RTT %.2fs >= %.2fs",
+                            peer, rtt, self.max_latency_s,
+                        )
                         raise ConnectionError("latency breaker tripped")
                     continue
                 await conn.receiver.put(msg)

@@ -61,7 +61,7 @@ class LocalProcessRunner(Runner):
         self,
         working_dir: str,
         tps_per_node: int = 100,
-        verifier: str = "accept",
+        verifier: str = "cpu",
     ) -> None:
         self.working_dir = working_dir
         self.tps_per_node = tps_per_node

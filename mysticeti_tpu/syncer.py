@@ -16,7 +16,10 @@ from typing import List, Sequence
 
 from .commit_observer import CommitObserver
 from .core import Core
+from .tracing import logger
 from .types import AuthoritySet, BlockReference, RoundNumber, StatementBlock
+
+log = logger(__name__)
 
 
 class SyncerSignals:
@@ -80,6 +83,12 @@ class Syncer:
                 return  # no commits needed once the epoch is safe to close
 
             newly_committed = self.core.try_commit()
+            if newly_committed:
+                log.debug(
+                    "committed %d leaders up to round %d",
+                    len(newly_committed),
+                    max(b.round() for b in newly_committed),
+                )
             committed_subdags = self.commit_observer.handle_commit(newly_committed)
             self.core.handle_committed_subdag(
                 committed_subdags, self.commit_observer.aggregator_state()

@@ -37,7 +37,7 @@ class BlockDisseminator:
         self,
         connection: Connection,
         block_store: BlockStore,
-        block_ready: asyncio.Event,
+        block_ready,  # Notify (net_sync.py): lost-wakeup-free level trigger
         parameters: Optional[SynchronizerParameters] = None,
         metrics=None,
     ) -> None:
@@ -59,6 +59,9 @@ class BlockDisseminator:
         cursor = from_round
         batch_size = self.parameters.batch_size
         while not self.connection.is_closed():
+            # Subscribe BEFORE reading the store: a block landing between the
+            # read and the wait then still wakes us (no lost edge).
+            waiter = self.block_ready.subscribe()
             blocks = self.block_store.get_own_blocks(cursor, batch_size)
             if blocks:
                 cursor = max(b.round() for b in blocks)
@@ -66,10 +69,9 @@ class BlockDisseminator:
                     Blocks(tuple(b.to_bytes() for b in blocks))
                 )
             else:
-                waiter = asyncio.ensure_future(self.block_ready.wait())
                 try:
                     await asyncio.wait_for(
-                        waiter, timeout=self.parameters.stream_interval_s
+                        waiter.wait(), timeout=self.parameters.stream_interval_s
                     )
                 except asyncio.TimeoutError:
                     pass

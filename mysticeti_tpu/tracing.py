@@ -1,0 +1,108 @@
+"""Logging: env-filterable levels + the virtual-time per-authority formatter.
+
+Capability parity with the reference's tracing setup:
+
+* env-filter levels a la ``RUST_LOG`` (``mysticeti/src/main.rs:80-83``):
+  ``MYSTICETI_LOG="info"`` or ``MYSTICETI_LOG="net_sync=debug,core=info,warning"``
+  — bare token sets the package root level, ``module=level`` tokens set
+  per-module levels (module names relative to ``mysticeti_tpu``).
+* the simulator formatter (``simulator_tracing.rs:14-56``): when a log record
+  is emitted inside a :class:`~mysticeti_tpu.runtime.simulated.DeterministicLoop`
+  the timestamp printed is the VIRTUAL time, and the emitting validator's
+  authority index (a contextvar set per simulated node task) prefixes the
+  line — so a 10-node sim failure produces one readable interleaved trace.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import logging
+import os
+import sys
+from typing import Optional
+
+# Which authority (validator index) the current task belongs to — the
+# equivalent of future_simulator.rs:336-361's per-node context.
+current_authority: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "mysticeti_authority", default=None
+)
+
+PACKAGE = "mysticeti_tpu"
+
+_LEVELS = {
+    "trace": logging.DEBUG,  # python has no TRACE; map to DEBUG
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "off": logging.CRITICAL + 10,
+}
+
+
+class SimAwareFormatter(logging.Formatter):
+    """``[  12.345s A3] level module: msg`` under a virtual-time loop,
+    wall-clock otherwise."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from .runtime.simulated import DeterministicLoop
+
+        stamp = None
+        try:
+            loop = asyncio.get_running_loop()
+            if isinstance(loop, DeterministicLoop):
+                stamp = f"{loop.time():9.3f}s"
+        except RuntimeError:
+            pass
+        if stamp is None:
+            stamp = self.formatTime(record, "%H:%M:%S")
+        authority = current_authority.get()
+        who = f" A{authority}" if authority is not None else ""
+        module = record.name
+        if module.startswith(PACKAGE + "."):
+            module = module[len(PACKAGE) + 1 :]
+        return (
+            f"[{stamp}{who}] {record.levelname.lower():<7} {module}: "
+            f"{record.getMessage()}"
+        )
+
+
+def setup_logging(
+    spec: Optional[str] = None, stream=None, force: bool = False
+) -> None:
+    """Install the handler/levels from ``spec`` (default: $MYSTICETI_LOG).
+
+    No-op when the env var is unset and no spec given (library mode: stay
+    silent, as the reference does without RUST_LOG).
+    """
+    if spec is None:
+        spec = os.environ.get("MYSTICETI_LOG")
+    if not spec:
+        return
+    root = logging.getLogger(PACKAGE)
+    if root.handlers and not force:
+        return
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(SimAwareFormatter())
+    root.addHandler(handler)
+    root.propagate = False
+    base_level = logging.INFO
+    for token in spec.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        if "=" in token:
+            module, _, level = token.partition("=")
+            logging.getLogger(f"{PACKAGE}.{module.strip()}").setLevel(
+                _LEVELS.get(level.strip().lower(), logging.INFO)
+            )
+        else:
+            base_level = _LEVELS.get(token.lower(), logging.INFO)
+    root.setLevel(base_level)
+
+
+def logger(name: str) -> logging.Logger:
+    """Module logger factory: ``logger(__name__)``."""
+    return logging.getLogger(name)

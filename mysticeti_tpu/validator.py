@@ -37,7 +37,10 @@ from .core import Core, CoreOptions
 from .crypto import Signer
 from .metrics import MetricReporter, Metrics, serve_metrics
 from .net_sync import NetworkSyncer
+from .tracing import current_authority, logger, setup_logging
 from .network import TcpNetwork
+
+log = logger(__name__)
 from .transactions_generator import TransactionGenerator
 from .wal import walf
 
@@ -53,12 +56,21 @@ class CommitConsumer:
         self.queue.put_nowait(sub_dag)
 
 
-def _make_verifier(kind: str, committee: Committee):
+def _make_verifier(kind: str, committee: Committee, metrics=None):
+    """Signature verification is ON by default (the reference always verifies
+    Ed25519 on every received block, types.rs:315-347 via net_sync.rs:352-372);
+    "accept" is an explicit consensus-only escape hatch, not a default."""
     if kind == "tpu":
-        return BatchedSignatureVerifier(committee, TpuSignatureVerifier())
+        return BatchedSignatureVerifier(
+            committee, TpuSignatureVerifier(), metrics=metrics
+        )
     if kind == "cpu":
-        return BatchedSignatureVerifier(committee, CpuSignatureVerifier())
-    return AcceptAllBlockVerifier()
+        return BatchedSignatureVerifier(
+            committee, CpuSignatureVerifier(), metrics=metrics
+        )
+    if kind == "accept":
+        return AcceptAllBlockVerifier()
+    raise ValueError(f"unknown verifier kind {kind!r}")
 
 
 class Validator:
@@ -91,11 +103,14 @@ class Validator:
         signer: Optional[Signer] = None,
         tps: Optional[int] = None,
         transaction_size: int = 512,
-        verifier: str = "accept",
+        verifier: str = "cpu",
         serve_metrics_endpoint: bool = True,
         network: Optional[object] = None,
     ) -> "Validator":
         v = cls()
+        setup_logging()
+        current_authority.set(authority)
+        log.info("starting benchmarking validator %d (verifier=%s)", authority, verifier)
         v.metrics = Metrics()
         (recovered, observer_recovered, wal_writer) = cls.init_storage(
             authority, committee, private
@@ -146,7 +161,7 @@ class Validator:
             observer,
             network,
             parameters=parameters,
-            block_verifier=_make_verifier(verifier, committee),
+            block_verifier=_make_verifier(verifier, committee, v.metrics),
             metrics=v.metrics,
             start_wal_sync_thread=True,
         )
@@ -173,6 +188,9 @@ class Validator:
         network: Optional[object] = None,
     ) -> Tuple["Validator", SimpleBlockHandler, CommitConsumer]:
         v = cls()
+        setup_logging()
+        current_authority.set(authority)
+        log.info("starting production validator %d (verifier=%s)", authority, verifier)
         v.metrics = Metrics()
         (recovered, observer_recovered, wal_writer) = cls.init_storage(
             authority, committee, private
@@ -210,7 +228,7 @@ class Validator:
             observer,
             network,
             parameters=parameters,
-            block_verifier=_make_verifier(verifier, committee),
+            block_verifier=_make_verifier(verifier, committee, v.metrics),
             metrics=v.metrics,
             start_wal_sync_thread=True,
         )

@@ -78,8 +78,21 @@ class WalWriter:
             crc = zlib.crc32(p, crc)
         header = _HEADER.pack(WAL_MAGIC, crc, length, tag)
         position = self._pos
-        os.writev(self._fd, [header, *parts])
-        self._pos = position + HEADER_SIZE + length
+        total = HEADER_SIZE + length
+        # A short write (ENOSPC, signal) would desynchronize every WAL
+        # position recorded downstream — write until complete or fail loudly
+        # (the reference asserts written == expected, wal.rs:185).
+        written = os.writev(self._fd, [header, *parts])
+        if written != total:
+            buf = memoryview(b"".join([header, *parts]))
+            while written < total:
+                n = os.write(self._fd, buf[written:])
+                if n <= 0:
+                    raise WalError(
+                        f"short WAL write: {written}/{total} bytes at {position}"
+                    )
+                written += n
+        self._pos = position + total
         return position
 
     def position(self) -> WalPosition:

@@ -1,0 +1,127 @@
+"""Epoch close + finalization safety, on the deterministic simulator.
+
+Parity with the reference sim tier (net_sync.rs:602-707):
+
+* ``test_epoch_close`` — every node reaches SAFE_TO_CLOSE and shuts itself
+  down through the epoch watch + grace period (net_sync.rs:466-494,602-642).
+* ``test_epoch_commit_sequence_equality`` — EXACT commit-sequence equality
+  across nodes within the closed epoch (net_sync.rs:643-661).
+* ``test_finalization_safety`` — the offline :class:`FinalizationInterpreter`
+  re-derivation agrees with the online pipeline: every finalized transaction
+  has a certifying block inside the committed causal history
+  (net_sync.rs:663-707).
+"""
+import asyncio
+import os
+
+import pytest
+
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.config import Parameters
+from mysticeti_tpu.finalization_interpreter import FinalizationInterpreter
+from mysticeti_tpu.runtime.simulated import run_simulation
+from mysticeti_tpu.simulated_network import SimulatedNetwork
+
+from test_net_sync_sim import build_node
+
+
+async def _run_epoch_nodes(n, tmp_dir, rounds_in_epoch=10, timeout_s=300.0):
+    committee = Committee.new_test([1] * n)
+    signers = Committee.benchmark_signers(n)
+    parameters = Parameters(
+        leader_timeout_s=1.0,
+        rounds_in_epoch=rounds_in_epoch,
+        shutdown_grace_period_s=2.0,
+    )
+    sim_net = SimulatedNetwork(n)
+    nodes = [
+        build_node(committee, signers, a, tmp_dir, sim_net, parameters)
+        for a in range(n)
+    ]
+    for node in nodes:
+        await node.start()
+    await sim_net.connect_all()
+    # Epoch-aware shutdown stops each node on its own; wait for all of them.
+    await asyncio.wait_for(
+        asyncio.gather(*[node.await_completion() for node in nodes]),
+        timeout=timeout_s,
+    )
+    sim_net.close()
+    return nodes
+
+
+def _committed(node):
+    return list(node.syncer.commit_observer.committed_leaders)
+
+
+def test_epoch_close(tmp_path):
+    nodes = run_simulation(_run_epoch_nodes(4, str(tmp_path)), seed=17)
+    for node in nodes:
+        assert node.core.epoch_closed(), "node never reached SAFE_TO_CLOSE"
+        # The epoch boundary was respected: commits exist and began before
+        # the epoch cutoff triggered the change.
+        assert len(_committed(node)) >= 3
+
+
+def test_epoch_commit_sequence_equality(tmp_path):
+    """Within a closed epoch the commit sequences are EXACTLY equal — not
+    just prefix-consistent (net_sync.rs:643-661)."""
+    nodes = run_simulation(_run_epoch_nodes(4, str(tmp_path)), seed=19)
+    sequences = [_committed(n) for n in nodes]
+    for seq in sequences[1:]:
+        assert seq == sequences[0], f"diverged: {seq} vs {sequences[0]}"
+
+
+def test_finalization_safety(tmp_path):
+    """Offline re-interpretation of each node's stored DAG: every finalized
+    transaction must have at least one certifying block linked from the last
+    committed leader (the fast path never finalizes outside the committed
+    history) — net_sync.rs:663-707."""
+    nodes = run_simulation(_run_epoch_nodes(4, str(tmp_path)), seed=23)
+    checked = 0
+    for node in nodes:
+        store = node.core.block_store
+        committee = node.core.committee
+        committed = _committed(node)
+        assert committed, "no commits to check against"
+        last_leader = store.get_block(committed[-1])
+        assert last_leader is not None
+
+        interpreter = FinalizationInterpreter(store, committee)
+        finalized = interpreter.finalized_tx_certifying_blocks()
+        assert finalized, "interpreter found no finalized transactions"
+        for _tx, certifying in finalized:
+            hit = False
+            for ref in certifying:
+                block = store.get_block(ref)
+                if block is not None and store.linked(last_leader, block):
+                    hit = True
+                    break
+            assert hit, f"finalized tx {_tx} has no committed certificate"
+            checked += 1
+    assert checked > 0
+
+
+def test_finalization_safety_detects_perturbation(tmp_path):
+    """The oracle has teeth: if the online pipeline were to under-commit
+    (simulated by pointing the check at an EARLY committed leader), the
+    cross-check fails — i.e. the assertion actually discriminates."""
+    nodes = run_simulation(_run_epoch_nodes(4, str(tmp_path)), seed=29)
+    node = nodes[0]
+    store = node.core.block_store
+    committee = node.core.committee
+    committed = _committed(node)
+    early_leader = store.get_block(committed[0])
+    interpreter = FinalizationInterpreter(store, committee)
+    finalized = interpreter.finalized_tx_certifying_blocks()
+    # At least one finalized tx must NOT be covered by the very first
+    # committed leader's history — otherwise the main test is vacuous.
+    uncovered = 0
+    for _tx, certifying in finalized:
+        if not any(
+            store.get_block(r) is not None
+            and store.linked(early_leader, store.get_block(r))
+            for r in certifying
+        ):
+            uncovered += 1
+    assert uncovered > 0, "oracle cannot distinguish commit prefixes"

@@ -139,9 +139,14 @@ def test_local_benchmark_end_to_end(tmp_path):
         collections = await orch.run_benchmarks()
         return collections
 
-    collections = asyncio.run(main())
-    assert len(collections) == 1
-    c = collections[0]
+    # One retry: subprocess validators at a fixed 14s budget are sensitive to
+    # machine load (e.g. concurrent XLA compiles in a full-suite run).
+    for attempt in range(2):
+        collections = asyncio.run(main())
+        assert len(collections) == 1
+        c = collections[0]
+        if c.scrapers and c.aggregate_tps() > 0:
+            break
     assert c.scrapers, "no scrapes succeeded"
     assert c.benchmark_duration() > 0
     assert c.aggregate_tps() > 0, c.display_summary()

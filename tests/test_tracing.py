@@ -1,0 +1,63 @@
+"""Tests for the env-filtered logging + virtual-time sim formatter."""
+import io
+import logging
+
+from mysticeti_tpu.runtime.simulated import DeterministicLoop
+from mysticeti_tpu.tracing import (
+    PACKAGE,
+    SimAwareFormatter,
+    current_authority,
+    logger,
+    setup_logging,
+)
+
+
+def _fresh_root():
+    root = logging.getLogger(PACKAGE)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    for lg in [root, logging.getLogger(f"{PACKAGE}.net_sync")]:
+        lg.setLevel(logging.NOTSET)
+    return root
+
+
+def test_env_filter_levels():
+    _fresh_root()
+    stream = io.StringIO()
+    setup_logging("net_sync=debug,warning", stream=stream, force=True)
+    logger(f"{PACKAGE}.net_sync").debug("dbg visible")
+    logger(f"{PACKAGE}.core").info("info hidden")
+    logger(f"{PACKAGE}.core").warning("warn visible")
+    out = stream.getvalue()
+    assert "dbg visible" in out
+    assert "info hidden" not in out
+    assert "warn visible" in out
+    _fresh_root()
+
+
+def test_unset_spec_is_noop():
+    root = _fresh_root()
+    setup_logging(spec=None if "MYSTICETI_LOG" not in __import__("os").environ else "")
+    assert not root.handlers
+
+
+def test_virtual_time_and_authority_prefix():
+    _fresh_root()
+    stream = io.StringIO()
+    setup_logging("debug", stream=stream, force=True)
+    loop = DeterministicLoop(seed=1)
+
+    async def main():
+        import asyncio
+
+        current_authority.set(3)
+        await asyncio.sleep(12.5)
+        logger(f"{PACKAGE}.net_sync").info("hello from sim")
+
+    loop.run_until_complete(main())
+    out = stream.getvalue()
+    assert "hello from sim" in out
+    assert "A3]" in out, out
+    assert "12.500s" in out, out
+    assert "net_sync:" in out
+    _fresh_root()
