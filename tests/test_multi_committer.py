@@ -156,3 +156,129 @@ def test_direct_skip(committee, tmp_path):
     assert len(sequence) == number_of_leaders
     assert sequence[0].kind == LeaderStatus.SKIP
     assert sequence[0].authority == leader_1
+
+
+def test_indirect_commit(committee, tmp_path):
+    """Leader 1 of wave 1 reaches only f+1 certificates at the decision round
+    (not 2f+1): the direct rule cannot commit it, but the wave-2 anchor finds
+    a certified link — indirect commit (multi_committer_tests.rs:370)."""
+    number_of_leaders = committee.quorum_threshold()
+    quorum = committee.quorum_threshold()
+    validity = committee.validity_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+
+    leader_round_1 = WAVE
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+
+    # Only 2f+1 validators vote for leader 1.
+    voters = list(committee.authority_indexes())[:quorum]
+    non_voters = list(committee.authority_indexes())[quorum:]
+    references_with_votes = build_dag_layer(
+        [(a, references_1) for a in voters], writer
+    )
+    references_without_votes = build_dag_layer(
+        [(a, references_without_leader_1) for a in non_voters], writer
+    )
+
+    # Only f+1 validators certify leader 1.
+    references_3 = []
+    certifiers = list(committee.authority_indexes())[:validity]
+    rest = list(committee.authority_indexes())[validity:]
+    references_3 += build_dag_layer(
+        [(a, references_with_votes) for a in certifiers], writer
+    )
+    mixed = (references_without_votes + references_with_votes)[:quorum]
+    references_3 += build_dag_layer([(a, mixed) for a in rest], writer)
+
+    decision_round_3 = 3 * WAVE - 1
+    build_dag(committee, writer, references_3, decision_round_3)
+
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 2 * number_of_leaders
+    assert sequence[0].kind == LeaderStatus.COMMIT
+    assert sequence[0].block.author() == leader_1
+
+
+def test_indirect_skip(committee, tmp_path):
+    """Only f+1 validators link to the first leader of wave 2: undecided by
+    the direct rule, and the wave-3 anchor finds no certificate — indirect
+    skip, while the other wave-2 leaders commit (multi_committer_tests.rs:469)."""
+    number_of_leaders = committee.quorum_threshold()
+    validity = committee.validity_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+
+    leader_round_2 = 2 * WAVE
+    references_2 = build_dag(committee, writer, None, leader_round_2)
+    leader_2 = committee.elect_leader(leader_round_2, 0)
+    references_without_leader_2 = [
+        r for r in references_2 if r.authority != leader_2
+    ]
+
+    linkers = list(committee.authority_indexes())[:validity]
+    others = list(committee.authority_indexes())[validity:]
+    references = build_dag_layer(
+        [(a, references_2) for a in linkers], writer
+    ) + build_dag_layer(
+        [(a, references_without_leader_2) for a in others], writer
+    )
+
+    decision_round_3 = 4 * WAVE - 1
+    build_dag(committee, writer, references, decision_round_3)
+
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 3 * number_of_leaders
+
+    # Wave 1: all leaders commit.
+    for n in range(number_of_leaders):
+        status = sequence[n]
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == committee.elect_leader(WAVE, n)
+    # Wave 2: first leader skipped, the rest commit.
+    for n in range(number_of_leaders):
+        status = sequence[number_of_leaders + n]
+        if n == 0:
+            assert status.kind == LeaderStatus.SKIP
+            assert status.authority == leader_2
+            assert status.round == leader_round_2
+        else:
+            assert status.kind == LeaderStatus.COMMIT
+            assert status.block.author() == committee.elect_leader(leader_round_2, n)
+    # Wave 3: all leaders commit.
+    for n in range(number_of_leaders):
+        status = sequence[2 * number_of_leaders + n]
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == committee.elect_leader(3 * WAVE, n)
+
+
+def test_undecided(committee, tmp_path):
+    """One vote for the leader: not enough support to commit, not enough
+    blame to skip — nothing commits (multi_committer_tests.rs:592)."""
+    number_of_leaders = committee.quorum_threshold()
+    quorum = committee.quorum_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+
+    leader_round_1 = WAVE
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+
+    indexes = list(committee.authority_indexes())
+    connections = [(indexes[0], references_1)] + [
+        (a, references_without_leader) for a in indexes[1:quorum]
+    ]
+    references = build_dag_layer(connections, writer)
+
+    decision_round_1 = 2 * WAVE - 1
+    build_dag(committee, writer, references, decision_round_1)
+
+    committer = make_committer(committee, writer, number_of_leaders)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert sequence == []

@@ -117,3 +117,114 @@ def test_direct_skip(committee, tmp_path):
     assert sequence[0].kind == LeaderStatus.SKIP
     assert sequence[0].authority == leader_1
     assert sequence[0].round == leader_round_1
+
+
+def test_indirect_commit(committee, tmp_path):
+    """Leader 1 gets 2f+1 votes but only f+1 certificates: direct rule cannot
+    decide, a later anchor commits it indirectly
+    (pipelined_committer_tests.rs:285)."""
+    quorum = committee.quorum_threshold()
+    validity = committee.validity_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+
+    leader_round_1 = 1
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader_1 = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+
+    voters = list(committee.authority_indexes())[:quorum]
+    non_voters = list(committee.authority_indexes())[quorum:]
+    references_with_votes = build_dag_layer(
+        [(a, references_1) for a in voters], writer
+    )
+    references_without_votes = build_dag_layer(
+        [(a, references_without_leader_1) for a in non_voters], writer
+    )
+
+    references_3 = []
+    certifiers = list(committee.authority_indexes())[:validity]
+    rest = list(committee.authority_indexes())[validity:]
+    references_3 += build_dag_layer(
+        [(a, references_with_votes) for a in certifiers], writer
+    )
+    mixed = (references_without_votes + references_with_votes)[:quorum]
+    references_3 += build_dag_layer([(a, mixed) for a in rest], writer)
+
+    decision_round = 2 * WAVE + 1
+    build_dag(committee, writer, references_3, decision_round)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 5
+    assert sequence[0].kind == LeaderStatus.COMMIT
+    assert sequence[0].block.author() == leader_1
+
+
+def test_indirect_skip(committee, tmp_path):
+    """Only f+1 validators link to the 4th leader: its own committer cannot
+    decide, and the anchor finds no certificate — skip it indirectly, commit
+    the leaders before and after (pipelined_committer_tests.rs:385)."""
+    validity = committee.validity_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+
+    leader_round_4 = WAVE + 1
+    references_4 = build_dag(committee, writer, None, leader_round_4)
+    leader_4 = committee.elect_leader(leader_round_4, 0)
+    references_without_leader_4 = [
+        r for r in references_4 if r.authority != leader_4
+    ]
+
+    linkers = list(committee.authority_indexes())[:validity]
+    others = list(committee.authority_indexes())[validity:]
+    references_5 = build_dag_layer(
+        [(a, references_4) for a in linkers], writer
+    ) + build_dag_layer(
+        [(a, references_without_leader_4) for a in others], writer
+    )
+
+    decision_round_7 = 3 * WAVE
+    build_dag(committee, writer, references_5, decision_round_7)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert len(sequence) == 7
+
+    for i in range(3):
+        status = sequence[i]
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == committee.elect_leader(i + 1, 0)
+    assert sequence[3].kind == LeaderStatus.SKIP
+    assert sequence[3].authority == leader_4
+    assert sequence[3].round == leader_round_4
+    for i in range(4, 7):
+        status = sequence[i]
+        assert status.kind == LeaderStatus.COMMIT
+        assert status.block.author() == committee.elect_leader(i + 1, 0)
+
+
+def test_undecided(committee, tmp_path):
+    """One vote for the first leader: neither committed nor skipped
+    (pipelined_committer_tests.rs:482)."""
+    quorum = committee.quorum_threshold()
+    writer = DagBlockWriter(committee, str(tmp_path))
+
+    leader_round_1 = 1
+    references_1 = build_dag(committee, writer, None, leader_round_1)
+    leader_1 = committee.elect_leader(leader_round_1, 0)
+    references_without_leader = [
+        r for r in references_1 if r.authority != leader_1
+    ]
+
+    indexes = list(committee.authority_indexes())
+    connections = [(indexes[0], references_1)] + [
+        (a, references_without_leader) for a in indexes[1:quorum]
+    ]
+    references = build_dag_layer(connections, writer)
+
+    build_dag(committee, writer, references, WAVE)
+
+    committer = make_committer(committee, writer)
+    sequence = committer.try_commit(AuthorityRound(0, 0))
+    assert sequence == []
