@@ -144,6 +144,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     t.add_argument("--duration", type=float, default=30.0)
     t.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
 
+    o = sub.add_parser(
+        "orchestrator",
+        help="run a local benchmark sweep: boot a fleet, scrape, summarize, plot",
+    )
+    o.add_argument("--settings", help="settings.json path (overrides most flags)")
+    o.add_argument("--nodes", type=int, default=4)
+    o.add_argument("--loads", type=int, nargs="+", default=[100],
+                   help="fixed offered loads (tx/s) to sweep")
+    o.add_argument("--search", action="store_true",
+                   help="binary-search the max sustainable load instead")
+    o.add_argument("--starting-load", type=int, default=100)
+    o.add_argument("--duration", type=float, default=60.0)
+    o.add_argument("--faults", type=int, default=0)
+    o.add_argument("--fault-kind", choices=["none", "permanent", "crash-recovery"],
+                   default="none")
+    o.add_argument("--fault-interval", type=float, default=30.0)
+    o.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
+    o.add_argument("--tps-per-node", type=int, default=None,
+                   help="override the generator load split (default: load/nodes)")
+    o.add_argument("--working-directory", default="benchmark-fleet")
+    o.add_argument("--results-dir", default="benchmark-results")
+    o.add_argument("--scrape-interval", type=float, default=10.0)
+    o.add_argument("--plot", action="store_true", help="write latency-throughput plot")
+
     args = parser.parse_args(argv)
 
     if args.command == "benchmark-genesis":
@@ -183,7 +207,71 @@ def main(argv: Optional[List[str]] = None) -> int:
         for i, seq in enumerate(committed):
             print(f"validator {i}: {len(seq)} committed leaders")
         return 0
+    if args.command == "orchestrator":
+        return run_orchestrator(args)
     return 1
+
+
+def run_orchestrator(args) -> int:
+    """The orchestrator CLI (orchestrator/src/main.rs:36-195 equivalent):
+    fixed-load sweep or max-load binary search over a local fleet, with
+    summaries, log analysis, and an optional latency-throughput plot."""
+    from .orchestrator.benchmark import LoadType, ParametersGenerator
+    from .orchestrator.faults import FaultsType
+    from .orchestrator.logs import analyze_logs
+    from .orchestrator.orchestrator import Orchestrator
+    from .orchestrator.plot import plot_latency_throughput
+    from .orchestrator.settings import Settings
+
+    if args.settings:
+        settings = Settings.load(args.settings)
+    else:
+        settings = Settings(
+            working_dir=args.working_directory,
+            results_dir=args.results_dir,
+            verifier=args.verifier,
+        )
+    if args.tps_per_node is not None:
+        settings.tps_per_node = args.tps_per_node
+    # Otherwise the per-run offered load flows through Runner.configure
+    # (parameters.load // nodes) and any settings.json value stays the default.
+
+    if args.fault_kind == "permanent":
+        faults = FaultsType.permanent(args.faults)
+    elif args.fault_kind == "crash-recovery":
+        faults = FaultsType.crash_recovery(args.faults, args.fault_interval)
+    else:
+        faults = FaultsType.none()
+
+    load_type = (
+        LoadType.search(args.starting_load)
+        if args.search
+        else LoadType.fixed(list(args.loads))
+    )
+    generator = ParametersGenerator(
+        args.nodes, load_type, duration_s=args.duration, faults=faults
+    )
+    runner = settings.make_runner()
+    orchestrator = Orchestrator(
+        runner,
+        generator,
+        results_dir=settings.results_dir,
+        scrape_interval_s=args.scrape_interval,
+    )
+    collections = asyncio.run(orchestrator.run_benchmarks())
+    for c in collections:
+        print(c.display_summary())
+    if args.search:
+        print(f"max sustainable load: {generator.max_sustainable_load()} tx/s")
+    analysis = analyze_logs(settings.working_dir)
+    print(analysis.display())
+    if args.plot:
+        written = plot_latency_throughput(
+            collections, os.path.join(settings.results_dir, "latency-throughput")
+        )
+        for path in written:
+            print(f"wrote {path}")
+    return 0
 
 
 if __name__ == "__main__":

@@ -51,7 +51,7 @@ class Orchestrator:
 
     async def _run_one(self, parameters: BenchmarkParameters) -> MeasurementsCollection:
         await self.runner.cleanup()
-        await self.runner.configure(parameters.nodes)
+        await self.runner.configure(parameters.nodes, parameters.load)
         for authority in range(parameters.nodes):
             await self.runner.boot_node(authority)
 

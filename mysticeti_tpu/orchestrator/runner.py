@@ -24,7 +24,7 @@ from ..config import Parameters
 
 
 class Runner:
-    async def configure(self, committee_size: int) -> None:
+    async def configure(self, committee_size: int, load_tx_s: int = 0) -> None:
         raise NotImplementedError
 
     async def boot_node(self, authority: int) -> None:
@@ -61,17 +61,23 @@ class LocalProcessRunner(Runner):
         self,
         working_dir: str,
         tps_per_node: int = 100,
+        transaction_size: int = 512,
         verifier: str = "cpu",
     ) -> None:
         self.working_dir = working_dir
         self.tps_per_node = tps_per_node
+        self.transaction_size = transaction_size
         self.verifier = verifier
         self.committee_size = 0
         self.processes: Dict[int, asyncio.subprocess.Process] = {}
         self.parameters: Optional[Parameters] = None
 
-    async def configure(self, committee_size: int) -> None:
+    async def configure(self, committee_size: int, load_tx_s: int = 0) -> None:
         self.committee_size = committee_size
+        if load_tx_s > 0:
+            # The sweep's offered load for THIS run, split across the committee
+            # (protocol/mysticeti.rs:116 passes TPS the same way).
+            self.tps_per_node = max(1, load_tx_s // committee_size)
         benchmark_genesis(["127.0.0.1"] * committee_size, self.working_dir)
         self.parameters = Parameters.load(
             os.path.join(self.working_dir, "parameters.yaml")
@@ -80,6 +86,7 @@ class LocalProcessRunner(Runner):
     async def boot_node(self, authority: int) -> None:
         env = dict(os.environ)
         env["TPS"] = str(self.tps_per_node)
+        env["TRANSACTION_SIZE"] = str(self.transaction_size)
         env.setdefault("INITIAL_DELAY", "1")
         log = open(os.path.join(self.working_dir, f"node-{authority}.log"), "ab")
         proc = await asyncio.create_subprocess_exec(
@@ -159,8 +166,10 @@ class SshRunner(Runner):
         out, _ = await proc.communicate()
         return proc.returncode or 0, out
 
-    async def configure(self, committee_size: int) -> None:
+    async def configure(self, committee_size: int, load_tx_s: int = 0) -> None:
         assert committee_size <= len(self.hosts)
+        if load_tx_s > 0:
+            self.tps_per_node = max(1, load_tx_s // committee_size)
         import tempfile
 
         local = tempfile.mkdtemp(prefix="mysticeti-genesis-")

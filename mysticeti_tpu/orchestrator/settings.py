@@ -1,0 +1,69 @@
+"""Benchmark settings model: the orchestrator's persisted configuration.
+
+Capability parity with ``orchestrator/src/settings.rs`` (:53-96) minus the
+cloud-SDK fields the environment rules out: runner selection (local
+subprocesses vs an ssh fleet), host list, working/results directories, load
+generation defaults.  JSON on disk so a testbed description can be checked
+in and shared (the reference's ``settings.json``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Settings:
+    runner: str = "local"  # "local" | "ssh"
+    hosts: List[str] = field(default_factory=list)  # ssh: 1 per node, may be user@host
+    remote_repo: str = "."  # remote checkout path for the ssh runner
+    working_dir: str = "benchmark-fleet"
+    results_dir: str = "benchmark-results"
+    tps_per_node: int = 100
+    transaction_size: int = 512
+    verifier: str = "cpu"
+
+    def validate(self) -> None:
+        if self.runner not in ("local", "ssh"):
+            raise ValueError(f"unknown runner {self.runner!r}")
+        if self.runner == "ssh" and not self.hosts:
+            raise ValueError("ssh runner requires at least one host")
+
+    def make_runner(self):
+        """Instantiate the configured Runner (runner.py)."""
+        self.validate()
+        if self.runner == "local":
+            from .runner import LocalProcessRunner
+
+            return LocalProcessRunner(
+                self.working_dir,
+                tps_per_node=self.tps_per_node,
+                transaction_size=self.transaction_size,
+                verifier=self.verifier,
+            )
+        from .runner import SshRunner
+
+        return SshRunner(
+            self.hosts,
+            remote_repo=self.remote_repo,
+            working_dir=self.working_dir,
+            tps_per_node=self.tps_per_node,
+            verifier=self.verifier,
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Settings":
+        with open(path) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        settings = cls(**{k: v for k, v in raw.items() if k in known})
+        settings.validate()
+        return settings

@@ -142,6 +142,9 @@ class Validator:
             recovered_state=observer_recovered,
         )
         tps = tps if tps is not None else int(os.environ.get("TPS", "10"))
+        transaction_size = int(
+            os.environ.get("TRANSACTION_SIZE", str(transaction_size))
+        )
         v.generator = TransactionGenerator(
             submit=handler.submit,
             seed=authority,

@@ -95,15 +95,24 @@ def _assert_prefix_consistent(sequences):
 def test_four_nodes_commit(tmp_path):
     nodes = run_simulation(_run_nodes(4, str(tmp_path), 30.0), seed=3)
     sequences = [_committed(n) for n in nodes]
-    assert all(len(s) >= 3 for s in sequences), [len(s) for s in sequences]
+    # Rate-scaled threshold: the healthy configuration commits ~12 leaders
+    # per virtual second (measured 363 in 30 s); 150 catches any 2x liveness
+    # regression while leaving headroom for seed variation.
+    assert all(len(s) >= 150 for s in sequences), [len(s) for s in sequences]
     _assert_prefix_consistent(sequences)
+    # No-fault equal-progress: nodes may only differ by a small tail.
+    lengths = sorted(len(s) for s in sequences)
+    assert lengths[-1] - lengths[0] <= 5, lengths
 
 
 def test_ten_nodes_commit(tmp_path):
     nodes = run_simulation(_run_nodes(10, str(tmp_path), 25.0), seed=5)
     sequences = [_committed(n) for n in nodes]
-    assert all(len(s) >= 2 for s in sequences), [len(s) for s in sequences]
+    # Measured 280 in 25 s; 120 = 2x-regression tripwire.
+    assert all(len(s) >= 120 for s in sequences), [len(s) for s in sequences]
     _assert_prefix_consistent(sequences)
+    lengths = sorted(len(s) for s in sequences)
+    assert lengths[-1] - lengths[0] <= 5, lengths
 
 
 def test_determinism_same_seed(tmp_path):
@@ -125,7 +134,9 @@ def test_one_node_down(tmp_path):
         _run_nodes(4, str(tmp_path), 40.0, fault=fault), seed=11
     )
     sequences = [_committed(n) for n in nodes[:3]]
-    assert all(len(s) >= 2 for s in sequences), [len(s) for s in sequences]
+    # 3/4 quorum with one silent leader slot: slower than full strength but
+    # must stay within the same order of magnitude (measured healthy ~12/s).
+    assert all(len(s) >= 40 for s in sequences), [len(s) for s in sequences]
     _assert_prefix_consistent(sequences)
 
 
@@ -146,7 +157,7 @@ def test_partition_heals(tmp_path):
     )
     sequences = [_committed(n) for n in nodes]
     # The majority made progress...
-    assert all(len(s) >= 3 for s in sequences[1:])
+    assert all(len(s) >= 100 for s in sequences[1:]), [len(s) for s in sequences]
     # ...and the healed node caught up with a consistent (possibly shorter) prefix.
     _assert_prefix_consistent(sequences)
     assert len(sequences[0]) >= 1, "partitioned node never caught up"

@@ -1,0 +1,90 @@
+"""Tests for the orchestrator periphery: logs analyzer, plot, settings, CLI."""
+import json
+import os
+
+from mysticeti_tpu.orchestrator.logs import analyze_log_text, analyze_logs
+from mysticeti_tpu.orchestrator.measurement import Measurement, MeasurementsCollection
+from mysticeti_tpu.orchestrator.plot import plot_latency_throughput
+from mysticeti_tpu.orchestrator.settings import Settings
+
+
+def test_log_analyzer(tmp_path):
+    (tmp_path / "node-0.log").write_text(
+        "[00:00:01 A0] info validator: starting\n"
+        "[00:00:02 A0] error net_sync: boom\n"
+    )
+    (tmp_path / "node-1.log").write_text(
+        "ok line\nTraceback (most recent call last):\n  File x\nValueError: y\n"
+    )
+    analysis = analyze_logs(str(tmp_path))
+    assert analysis.node_errors["node-0.log"] == 1
+    assert analysis.node_crashes["node-1.log"] == 1
+    assert not analysis.ok()
+    assert "node-0.log" in analysis.display()
+    assert analyze_log_text("all is well\n") == (0, 0)
+
+
+def _collection(nodes, load, tps, latency):
+    c = MeasurementsCollection(
+        {"nodes": nodes, "load": load, "duration_s": 10.0,
+         "faults": {"kind": "none", "faults": 0, "interval_s": 60.0}}
+    )
+    n = int(tps * 10)
+    c.add(
+        "0",
+        Measurement(
+            timestamp_s=10.0,
+            benchmark_duration_s=10.0,
+            count=n,
+            sum_s=latency * n,
+            squared_sum_s=latency * latency * n,
+        ),
+    )
+    return c
+
+
+def test_plot_writes_txt_and_png(tmp_path):
+    cols = [
+        _collection(4, 100, 95.0, 0.2),
+        _collection(4, 200, 180.0, 0.35),
+    ]
+    out = str(tmp_path / "lt")
+    written = plot_latency_throughput(cols, out)
+    assert out + ".txt" in written
+    text = open(out + ".txt").read()
+    assert "4 nodes" in text
+    # matplotlib is available in this environment
+    assert out + ".png" in written
+    assert os.path.getsize(out + ".png") > 0
+
+
+def test_settings_roundtrip(tmp_path):
+    s = Settings(runner="local", working_dir="wd", tps_per_node=42)
+    path = str(tmp_path / "settings.json")
+    s.save(path)
+    loaded = Settings.load(path)
+    assert loaded == s
+    runner = loaded.make_runner()
+    assert runner.tps_per_node == 42
+
+    bad = Settings(runner="ssh", hosts=[])
+    try:
+        bad.validate()
+        raise AssertionError("ssh with no hosts must fail validation")
+    except ValueError:
+        pass
+    # Unknown keys in the file are tolerated (forward compatibility).
+    with open(path, "w") as f:
+        json.dump({"runner": "local", "future_field": 1}, f)
+    assert Settings.load(path).runner == "local"
+
+
+def test_orchestrator_cli_parses():
+    """--help for the subcommand exits 0 (argparse raises SystemExit)."""
+    import pytest
+
+    from mysticeti_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["orchestrator", "--help"])
+    assert exc.value.code == 0
