@@ -61,6 +61,11 @@ class SignatureVerifier:
     ) -> List[bool]:
         raise NotImplementedError
 
+    def warmup(self) -> None:
+        """Optional: pay one-time costs (tracing, compilation) before the
+        first real batch arrives.  Called from a background thread at node
+        boot; default no-op."""
+
 
 class CpuSignatureVerifier(SignatureVerifier):
     """The CPU oracle path (cryptography/OpenSSL) — reference behavior
@@ -100,6 +105,13 @@ class TpuSignatureVerifier(SignatureVerifier):
             pow2 = 1 << (n.bit_length() - 1)
             self._mesh = make_mesh(pow2) if pow2 > 1 else None
         return self._mesh
+
+    def warmup(self) -> None:
+        """Trace + compile (or load from the persistent cache) the smallest
+        bucket kernel so the first real block batch is not stalled ~15-30 s
+        behind JAX tracing."""
+        dummy = bytes(32)
+        self.verify_signatures([dummy], [dummy], [bytes(64)])
 
     def verify_signatures(self, public_keys, digests, signatures):
         mesh = self._resolve_mesh()

@@ -1,0 +1,72 @@
+"""Native (C++) runtime helpers with transparent build + pure-Python fallback.
+
+``native`` resolves to the compiled ``_native`` module, or ``None`` when no
+toolchain is available — callers must keep a Python fallback path (the
+extension is an acceleration, matching the reference's Rust storage hot paths,
+never a hard dependency).
+
+The extension is built on first import with ``g++ -O2 -shared -fPIC ... -lz``
+into this directory; set ``MYSTICETI_NO_NATIVE=1`` to disable both the build
+and the import (useful to pin tests to the fallback path).
+"""
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import shutil
+import subprocess
+import sysconfig
+import tempfile
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "mysticeti_native.cpp")
+_SO = os.path.join(_DIR, "_native.so")
+
+
+def _build() -> bool:
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        return False
+    include = sysconfig.get_path("include")
+    # Build to a temp file then atomically rename: concurrent processes
+    # (e.g. a validator fleet booting) race benignly.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    cmd = [
+        gxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", _SRC, "-o", tmp, "-lz",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            log.warning("native build failed: %s", proc.stderr.decode()[-500:])
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except Exception as exc:  # toolchain quirks must never break the node
+        log.warning("native build error: %r", exc)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    if os.environ.get("MYSTICETI_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    try:
+        return importlib.import_module("mysticeti_tpu.native._native")
+    except ImportError as exc:
+        log.warning("native import failed: %r", exc)
+        return None
+
+
+native = _load()

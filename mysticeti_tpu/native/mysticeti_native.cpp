@@ -1,0 +1,160 @@
+// Native runtime helpers for mysticeti-tpu (CPython C API, no pybind11).
+//
+// The reference implements its storage/wire hot paths in Rust
+// (mysticeti-core/src/wal.rs, network.rs); this extension is the C++
+// equivalent for the paths where pure Python measurably costs: the WAL
+// recovery scan (header walk + crc over every entry at node restart) and
+// scatter-gather entry framing.  Little-endian hosts only (x86-64 / aarch64
+// — same assumption the <IIII struct framing in wal.py already makes).
+//
+// Build: see mysticeti_tpu/native/__init__.py (g++ -O2 -shared -fPIC -lz).
+// Python fallbacks exist for every function; the extension is an
+// acceleration, not a requirement.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kWalMagic = 0x314C4157;  // b"WAL1"
+constexpr Py_ssize_t kHeaderSize = 16;      // magic, crc32, len, tag (u32 LE)
+
+// wal_scan(buffer, end) -> list[(pos, tag, payload_off, payload_len)]
+//
+// Walks entry headers from offset 0, validating magic and payload crc32.
+// Stops cleanly at the first invalid/torn entry — the crash-recovery
+// contract of WalReader.iter_until (wal.rs:270-293 semantics).  Offsets are
+// returned instead of payload copies so the caller can slice the mmap
+// zero-copy.
+PyObject* wal_scan(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  unsigned long long end_arg;
+  if (!PyArg_ParseTuple(args, "y*K", &buf, &end_arg)) return nullptr;
+
+  const uint8_t* data = static_cast<const uint8_t*>(buf.buf);
+  Py_ssize_t limit = static_cast<Py_ssize_t>(end_arg);
+  if (limit > buf.len) limit = buf.len;
+
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) {
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+
+  Py_ssize_t pos = 0;
+  while (pos + kHeaderSize <= limit) {
+    uint32_t magic, crc, length, tag;
+    std::memcpy(&magic, data + pos, 4);
+    std::memcpy(&crc, data + pos + 4, 4);
+    std::memcpy(&length, data + pos + 8, 4);
+    std::memcpy(&tag, data + pos + 12, 4);
+    if (magic != kWalMagic) break;
+    Py_ssize_t payload_off = pos + kHeaderSize;
+    if (payload_off + static_cast<Py_ssize_t>(length) > limit) break;
+
+    uint32_t actual;
+    Py_BEGIN_ALLOW_THREADS
+    actual = static_cast<uint32_t>(
+        crc32(0L, data + payload_off, static_cast<uInt>(length)));
+    Py_END_ALLOW_THREADS
+    if (actual != crc) break;
+
+    PyObject* item =
+        Py_BuildValue("(KIKI)", static_cast<unsigned long long>(pos), tag,
+                      static_cast<unsigned long long>(payload_off), length);
+    if (item == nullptr || PyList_Append(out, item) < 0) {
+      Py_XDECREF(item);
+      Py_DECREF(out);
+      PyBuffer_Release(&buf);
+      return nullptr;
+    }
+    Py_DECREF(item);
+    pos = payload_off + static_cast<Py_ssize_t>(length);
+  }
+
+  PyBuffer_Release(&buf);
+  return out;
+}
+
+// frame_entry(tag, parts) -> bytes
+//
+// Assemble one WAL entry (16-byte header + concatenated parts) with the
+// crc computed in a single pass — replaces the per-part Python crc loop +
+// struct.pack + join in WalWriter.writev.
+PyObject* frame_entry(PyObject*, PyObject* args) {
+  unsigned int tag;
+  PyObject* parts;
+  if (!PyArg_ParseTuple(args, "IO", &tag, &parts)) return nullptr;
+  PyObject* seq = PySequence_Fast(parts, "parts must be a sequence");
+  if (seq == nullptr) return nullptr;
+
+  // Acquire every part's buffer up front: total is computed from the SAME
+  // views the copy uses (PyObject_Length counts items, not bytes — sizing
+  // from it would overflow the output for itemsize > 1 buffers), and holding
+  // the views pins the lengths against concurrent mutation.
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::vector<Py_buffer> views(static_cast<size_t>(n));
+  Py_ssize_t total = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* part = PySequence_Fast_GET_ITEM(seq, i);
+    if (PyObject_GetBuffer(part, &views[i], PyBUF_SIMPLE) < 0) {
+      for (Py_ssize_t j = 0; j < i; ++j) PyBuffer_Release(&views[j]);
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    total += views[i].len;
+  }
+
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, kHeaderSize + total);
+  if (out == nullptr) {
+    for (Py_ssize_t i = 0; i < n; ++i) PyBuffer_Release(&views[i]);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  uint8_t* dst = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+  uint8_t* payload = dst + kHeaderSize;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    std::memcpy(payload, views[i].buf, views[i].len);
+    payload += views[i].len;
+    PyBuffer_Release(&views[i]);
+  }
+
+  uint32_t crc;
+  Py_BEGIN_ALLOW_THREADS
+  crc = static_cast<uint32_t>(
+      crc32(0L, dst + kHeaderSize, static_cast<uInt>(total)));
+  Py_END_ALLOW_THREADS
+
+  uint32_t magic = kWalMagic;
+  uint32_t length = static_cast<uint32_t>(total);
+  std::memcpy(dst, &magic, 4);
+  std::memcpy(dst + 4, &crc, 4);
+  std::memcpy(dst + 8, &length, 4);
+  std::memcpy(dst + 12, &tag, 4);
+
+  Py_DECREF(seq);
+  return out;
+}
+
+PyMethodDef kMethods[] = {
+    {"wal_scan", wal_scan, METH_VARARGS,
+     "Scan crc-framed WAL entries; returns (pos, tag, off, len) tuples."},
+    {"frame_entry", frame_entry, METH_VARARGS,
+     "Assemble one framed WAL entry (header + parts) with single-pass crc."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "Native runtime helpers (WAL framing/scan).", -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&kModule); }

@@ -20,6 +20,7 @@ control flow, batch dimension mapped across VPU lanes.
 from __future__ import annotations
 
 import hashlib
+import os
 from functools import partial
 from typing import Optional, Sequence, Tuple
 
@@ -31,6 +32,24 @@ import jax.numpy as jnp
 from . import field as F
 from . import scalar as SC
 from . import sha512 as H
+
+# The ladder costs ~40 s to compile; every process that dispatches it (node
+# subprocesses included — not just bench.py/pytest) must share the persistent
+# cache or a validator's first verification stalls a whole benchmark run.
+if jax.config.jax_compilation_cache_dir is None:
+    import tempfile
+
+    # Per-user path: a fixed world-writable /tmp dir would let another local
+    # user plant crafted cache entries (deserialized executables) or block
+    # writes with a permission collision.
+    _default_cache = os.path.join(
+        tempfile.gettempdir(), f"mysticeti-tpu-jax-cache-{os.getuid()}"
+    )
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", _default_cache),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 P = F.P
 L = (1 << 252) + 27742317777372353535851937790883648493  # group order

@@ -1,0 +1,139 @@
+"""Monitoring stack: prometheus + grafana provisioning for a benchmark fleet.
+
+Capability parity with ``orchestrator/src/monitor.rs`` (:60-184), adapted to
+this environment (no package installs): the orchestrator *generates* a ready
+prometheus scrape config covering every node's /metrics endpoint plus a
+grafana dashboard + datasource provisioning tree, and — when the binaries
+happen to exist on the host — can launch prometheus directly.  The generated
+tree is also exactly what the reference's grafana/prometheus containers mount.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+PROMETHEUS_PORT = 9090
+GRAFANA_PORT = 3000
+
+
+def prometheus_config(targets: List[str], scrape_interval_s: int = 5) -> str:
+    """YAML scrape config for the node metric endpoints (monitor.rs:105-148)."""
+    lines = [
+        "global:",
+        f"  scrape_interval: {scrape_interval_s}s",
+        f"  evaluation_interval: {scrape_interval_s}s",
+        "scrape_configs:",
+        "  - job_name: mysticeti-nodes",
+        "    static_configs:",
+        "      - targets:",
+    ]
+    for t in targets:
+        lines.append(f"          - {t}")
+    return "\n".join(lines) + "\n"
+
+
+def grafana_dashboard() -> dict:
+    """The benchmark dashboard: tps, latency percentiles, verifier series
+    (orchestrator/assets/grafana-dashboard.json equivalent, built for this
+    framework's metric names)."""
+
+    def panel(panel_id, title, expr, y):
+        return {
+            "id": panel_id,
+            "title": title,
+            "type": "timeseries",
+            "datasource": "mysticeti-prometheus",
+            "gridPos": {"h": 8, "w": 12, "x": (panel_id % 2) * 12, "y": y},
+            "targets": [{"expr": expr, "refId": "A"}],
+        }
+
+    return {
+        "title": "mysticeti-tpu benchmark",
+        "uid": "mysticeti-tpu",
+        "timezone": "utc",
+        "refresh": "5s",
+        "panels": [
+            panel(0, "committed tx/s", "rate(latency_s_count[30s])", 0),
+            panel(1, "avg latency (s)",
+                  "rate(latency_s_sum[30s]) / rate(latency_s_count[30s])", 0),
+            panel(2, "committed leaders/s", "rate(committed_leaders_total[30s])", 8),
+            panel(3, "verified signatures/s",
+                  "rate(verified_signatures_total[30s])", 8),
+            panel(4, "verify batch size p90",
+                  "histogram_quantile(0.9, rate(verify_batch_size_bucket[1m]))", 16),
+            panel(5, "peer RTT p90",
+                  "histogram_quantile(0.9, rate(connection_latency_bucket[1m]))", 16),
+        ],
+    }
+
+
+def grafana_provisioning(out_dir: str) -> None:
+    """Write the grafana provisioning tree (datasource + dashboard provider)."""
+    ds_dir = os.path.join(out_dir, "grafana", "provisioning", "datasources")
+    db_dir = os.path.join(out_dir, "grafana", "provisioning", "dashboards")
+    dash_dir = os.path.join(out_dir, "grafana", "dashboards")
+    for d in (ds_dir, db_dir, dash_dir):
+        os.makedirs(d, exist_ok=True)
+    with open(os.path.join(ds_dir, "prometheus.yaml"), "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "datasources:\n"
+            "  - name: mysticeti-prometheus\n"
+            "    type: prometheus\n"
+            f"    url: http://127.0.0.1:{PROMETHEUS_PORT}\n"
+            "    isDefault: true\n"
+        )
+    with open(os.path.join(db_dir, "provider.yaml"), "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "providers:\n"
+            "  - name: mysticeti\n"
+            "    folder: ''\n"
+            "    type: file\n"
+            "    options:\n"
+            "      path: /etc/grafana/dashboards\n"
+        )
+    with open(os.path.join(dash_dir, "mysticeti.json"), "w") as f:
+        json.dump(grafana_dashboard(), f, indent=2)
+
+
+class MonitoringStack:
+    """Generate the monitoring tree; start prometheus when available."""
+
+    def __init__(self, out_dir: str) -> None:
+        self.out_dir = out_dir
+        self.prometheus_proc: Optional[subprocess.Popen] = None
+
+    def deploy(self, metric_targets: List[str]) -> str:
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, "prometheus.yaml")
+        with open(path, "w") as f:
+            f.write(prometheus_config(metric_targets))
+        grafana_provisioning(self.out_dir)
+        return path
+
+    def start_prometheus(self) -> bool:
+        """Launch a local prometheus against the generated config when the
+        binary exists; returns False (config-only mode) otherwise."""
+        binary = shutil.which("prometheus")
+        if binary is None:
+            return False
+        self.prometheus_proc = subprocess.Popen(
+            [
+                binary,
+                f"--config.file={os.path.join(self.out_dir, 'prometheus.yaml')}",
+                f"--storage.tsdb.path={os.path.join(self.out_dir, 'tsdb')}",
+                f"--web.listen-address=127.0.0.1:{PROMETHEUS_PORT}",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return True
+
+    def stop(self) -> None:
+        if self.prometheus_proc is not None:
+            self.prometheus_proc.terminate()
+            self.prometheus_proc = None

@@ -61,9 +61,15 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
     Ed25519 on every received block, types.rs:315-347 via net_sync.rs:352-372);
     "accept" is an explicit consensus-only escape hatch, not a default."""
     if kind == "tpu":
-        return BatchedSignatureVerifier(
-            committee, TpuSignatureVerifier(), metrics=metrics
-        )
+        backend = TpuSignatureVerifier()
+        # Pay the JAX trace/compile (or cache load) off the hot path: blocks
+        # arriving during warmup just queue in the batching collector.
+        import threading
+
+        threading.Thread(
+            target=backend.warmup, daemon=True, name="verifier-warmup"
+        ).start()
+        return BatchedSignatureVerifier(committee, backend, metrics=metrics)
     if kind == "cpu":
         return BatchedSignatureVerifier(
             committee, CpuSignatureVerifier(), metrics=metrics
@@ -129,7 +135,9 @@ class Validator:
             parameters=parameters,
             recovered=recovered,
             wal_writer=wal_writer,
-            options=CoreOptions.production(),
+            # Reference benchmarking uses CoreOptions::default() (fsync=false,
+            # validator.rs:247): durability rides the 1 s WAL-sync thread.
+            options=CoreOptions(fsync=False),
             signer=signer,
             metrics=v.metrics,
         )

@@ -28,6 +28,8 @@ import threading
 import zlib
 from typing import Iterator, List, Optional, Sequence, Tuple
 
+from .native import native as _native
+
 Tag = int
 WalPosition = int
 
@@ -73,18 +75,23 @@ class WalWriter:
         length = sum(len(p) for p in parts)
         if length > MAX_ENTRY_SIZE:
             raise WalError(f"entry of {length} bytes exceeds MAX_ENTRY_SIZE")
-        crc = 0
-        for p in parts:
-            crc = zlib.crc32(p, crc)
-        header = _HEADER.pack(WAL_MAGIC, crc, length, tag)
+        if _native is not None:
+            # Single-pass native framing (header + parts + crc in one buffer).
+            frame_parts: Sequence[bytes] = (_native.frame_entry(tag, list(parts)),)
+        else:
+            crc = 0
+            for p in parts:
+                crc = zlib.crc32(p, crc)
+            header = _HEADER.pack(WAL_MAGIC, crc, length, tag)
+            frame_parts = (header, *parts)
         position = self._pos
         total = HEADER_SIZE + length
         # A short write (ENOSPC, signal) would desynchronize every WAL
         # position recorded downstream — write until complete or fail loudly
         # (the reference asserts written == expected, wal.rs:185).
-        written = os.writev(self._fd, [header, *parts])
+        written = os.writev(self._fd, list(frame_parts))
         if written != total:
-            buf = memoryview(b"".join([header, *parts]))
+            buf = memoryview(b"".join(frame_parts))
             while written < total:
                 n = os.write(self._fd, buf[written:])
                 if n <= 0:
@@ -210,6 +217,17 @@ class WalReader:
         pos: WalPosition = 0
         if end is None:
             end = os.fstat(self._fd).st_size
+        if _native is not None and end > 0:
+            m = self._ensure_mapped(end)
+            if m is None:
+                return
+            # Collect the offsets first, then slice the mmap directly
+            # (mmap slicing copies): no exported buffer lives across a yield,
+            # so concurrent remap/cleanup in other threads stays legal.
+            entries = _native.wal_scan(m, end)
+            for pos, tag, off, length in entries:
+                yield pos, tag, m[off : off + length]
+            return
         while pos + HEADER_SIZE <= end:
             header = self._read_header(pos)
             if header is None:

@@ -88,3 +88,43 @@ def test_orchestrator_cli_parses():
     with pytest.raises(SystemExit) as exc:
         cli.main(["orchestrator", "--help"])
     assert exc.value.code == 0
+
+
+def test_monitoring_stack_deploy(tmp_path):
+    from mysticeti_tpu.orchestrator.monitor import MonitoringStack, prometheus_config
+
+    stack = MonitoringStack(str(tmp_path / "monitor"))
+    cfg = stack.deploy(["127.0.0.1:1504", "127.0.0.1:1505"])
+    text = open(cfg).read()
+    assert "127.0.0.1:1504" in text and "scrape_interval: 5s" in text
+    dash = tmp_path / "monitor" / "grafana" / "dashboards" / "mysticeti.json"
+    assert dash.exists()
+    content = json.loads(dash.read_text())
+    assert any("latency_s_count" in p["targets"][0]["expr"] for p in content["panels"])
+    ds = tmp_path / "monitor" / "grafana" / "provisioning" / "datasources" / "prometheus.yaml"
+    assert "prometheus" in ds.read_text()
+
+
+def test_monitored_lock(tmp_path):
+    import asyncio
+
+    from mysticeti_tpu.utils.lock import MonitoredLock
+
+    async def main():
+        lock = MonitoredLock("test")
+        async with lock:
+            await asyncio.sleep(0.01)
+        assert lock.hold_total_s >= 0.01
+        # Contention is measured on the second waiter.
+        async def holder():
+            async with lock:
+                await asyncio.sleep(0.02)
+
+        task = asyncio.ensure_future(holder())
+        await asyncio.sleep(0.001)
+        async with lock:
+            pass
+        await task
+        assert lock.wait_total_s >= 0.01
+
+    asyncio.run(main())
