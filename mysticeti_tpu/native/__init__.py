@@ -33,8 +33,11 @@ def _build() -> bool:
     include = sysconfig.get_path("include")
     # Build to a temp file then atomically rename: concurrent processes
     # (e.g. a validator fleet booting) race benignly.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
-    os.close(fd)
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+    except OSError:  # read-only install dir: fall back to pure Python
+        return False
     cmd = [
         gxx, "-O2", "-std=c++17", "-shared", "-fPIC",
         f"-I{include}", _SRC, "-o", tmp, "-lz",
@@ -56,17 +59,29 @@ def _build() -> bool:
         return False
 
 
-def _load():
-    if os.environ.get("MYSTICETI_NO_NATIVE"):
-        return None
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-        if not _build():
-            return None
+def _import():
     try:
         return importlib.import_module("mysticeti_tpu.native._native")
     except ImportError as exc:
         log.warning("native import failed: %r", exc)
         return None
+
+
+def _load():
+    if os.environ.get("MYSTICETI_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SRC):
+        # Source-less deploy: a prebuilt .so may still match this interpreter.
+        return _import() if os.path.exists(_SO) else None
+    stale = not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    if stale and not _build():
+        return None
+    mod = _import()
+    if mod is None and not stale and _build():
+        # A fresh-looking .so can still target another ABI/arch (e.g. the
+        # checkout moved between interpreters); one rebuild fixes that.
+        mod = _import()
+    return mod
 
 
 native = _load()

@@ -134,6 +134,12 @@ class MonitoringStack:
         return True
 
     def stop(self) -> None:
-        if self.prometheus_proc is not None:
-            self.prometheus_proc.terminate()
-            self.prometheus_proc = None
+        proc, self.prometheus_proc = self.prometheus_proc, None
+        if proc is None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()

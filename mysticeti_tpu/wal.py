@@ -30,6 +30,16 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 from .native import native as _native
 
+
+def _close_quietly(m: mmap.mmap) -> None:
+    """Close a mapping, tolerating a transient buffer export (the native
+    wal_scan holds the buffer only for the duration of the call); the mapping
+    is then released when the last reference drops instead."""
+    try:
+        m.close()
+    except BufferError:
+        pass
+
 Tag = int
 WalPosition = int
 
@@ -164,7 +174,7 @@ class WalReader:
             if end > size:
                 return None
             if self._map is not None:
-                self._map.close()
+                _close_quietly(self._map)
             self._map = mmap.mmap(self._fd, size, prot=mmap.PROT_READ)
             self._map_size = size
             return self._map
@@ -173,7 +183,7 @@ class WalReader:
         """Drop the current mapping; returns number of retained maps (0/1)."""
         with self._lock:
             if self._map is not None:
-                self._map.close()
+                _close_quietly(self._map)
                 self._map = None
                 self._map_size = 0
         return 0
@@ -223,10 +233,19 @@ class WalReader:
                 return
             # Collect the offsets first, then slice the mmap directly
             # (mmap slicing copies): no exported buffer lives across a yield,
-            # so concurrent remap/cleanup in other threads stays legal.
+            # so concurrent remap/cleanup in other threads stays legal.  A
+            # cleanup() landing between yields closes the map under us — the
+            # slice then raises ValueError and we re-resolve the mapping.
             entries = _native.wal_scan(m, end)
             for pos, tag, off, length in entries:
-                yield pos, tag, m[off : off + length]
+                try:
+                    payload = m[off : off + length]
+                except ValueError:
+                    m = self._ensure_mapped(end)
+                    if m is None:
+                        return
+                    payload = m[off : off + length]
+                yield pos, tag, payload
             return
         while pos + HEADER_SIZE <= end:
             header = self._read_header(pos)

@@ -26,5 +26,5 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Persistent XLA compilation cache: the Ed25519 scan kernel costs ~60s to
 # compile on CPU; cache it across test sessions.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/mysticeti-tpu-jax-cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+# Persistent compilation cache: mysticeti_tpu.ops.ed25519 sets a per-uid,
+# ownership-checked default when JAX_COMPILATION_CACHE_DIR is unset.

@@ -18,15 +18,17 @@ def _entry(tag, payload):
 
 
 def test_wal_scan_matches_layout():
-    buf = _entry(1, b"alpha") + _entry(2, b"") + _entry(3, b"x" * 1000)
+    payloads = [(1, b"alpha"), (2, b""), (3, b"x" * 1000)]
+    buf = b"".join(_entry(t, p) for t, p in payloads)
     got = native.wal_scan(buf, len(buf))
     assert [(p, t, ln) for p, t, _, ln in got] == [
         (0, 1, 5),
         (21, 2, 0),
         (37, 3, 1000),
     ]
-    for pos, tag, off, ln in got:
-        assert buf[off : off + ln] == _entry(tag, buf[off : off + ln])[16:]
+    for (pos, tag, off, ln), (want_tag, want_payload) in zip(got, payloads):
+        assert tag == want_tag
+        assert buf[off : off + ln] == want_payload
 
 
 def test_wal_scan_stops_at_tear_and_corruption():

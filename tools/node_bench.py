@@ -20,8 +20,8 @@ import json
 import os
 import sys
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/mysticeti-tpu-jax-cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+# Persistent compilation cache: mysticeti_tpu.ops.ed25519 sets a per-uid,
+# ownership-checked default when JAX_COMPILATION_CACHE_DIR is unset.
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -29,13 +29,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def prewarm() -> None:
     """Compile the fused bucket kernels into the persistent cache so node
     subprocesses hit warm compiles."""
-    import random
-
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
     from mysticeti_tpu.ops import ed25519 as E
 
-    rng = random.Random(0)
     key = Ed25519PrivateKey.from_private_bytes(bytes(32))
     pk = key.public_key().public_bytes_raw()
     msg = bytes(32)
