@@ -38,7 +38,7 @@ def main() -> None:
     from mysticeti_tpu.ops import ed25519 as E
 
     batch = int(os.environ.get("BENCH_BATCH", "16384"))
-    iters = int(os.environ.get("BENCH_ITERS", "32"))
+    iters = int(os.environ.get("BENCH_ITERS", "64"))
 
     # Build a realistic batch: distinct signers over 32-byte block digests
     # (the framework's signed message is always a blake2b-256 digest).

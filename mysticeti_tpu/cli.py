@@ -65,6 +65,9 @@ async def run_node(
     tps: Optional[int] = None,
 ) -> None:
     """main.rs:159-185."""
+    from .profiling import start_from_env, stop_from_env
+
+    start_from_env()  # MYSTICETI_PROFILE=<path>.folded: lifetime flamegraph
     committee = Committee.load(committee_path)
     parameters = Parameters.load(parameters_path)
     private = PrivateConfig.new_in_dir(authority, private_dir)
@@ -80,7 +83,10 @@ async def run_node(
         tps=tps,
         verifier=verifier,
     )
-    await validator.network_syncer.await_completion()
+    try:
+        await validator.network_syncer.await_completion()
+    finally:
+        stop_from_env()
 
 
 async def testbed(committee_size: int, working_dir: str, duration_s: float,
@@ -168,6 +174,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     o.add_argument("--scrape-interval", type=float, default=10.0)
     o.add_argument("--plot", action="store_true", help="write latency-throughput plot")
 
+    f = sub.add_parser(
+        "fleet",
+        help="testbed lifecycle over a host pool: deploy/start/stop/destroy/"
+        "status/install/update/logs",
+    )
+    f.add_argument("action", choices=[
+        "deploy", "start", "stop", "destroy", "status", "install", "update",
+        "logs",
+    ])
+    f.add_argument("--settings", help="settings.json with the host pool")
+    f.add_argument("--hosts", nargs="*", default=None,
+                   help="host pool override (user@addr ...)")
+    f.add_argument("--count", type=int, default=None,
+                   help="deploy: number of instances (default: whole pool)")
+    f.add_argument("--region", default="local")
+    f.add_argument("--state", default="testbed-state.json",
+                   help="inventory state file")
+    f.add_argument("--dest", default="downloaded-logs", help="logs: local dir")
+
     args = parser.parse_args(argv)
 
     if args.command == "benchmark-genesis":
@@ -209,7 +234,56 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.command == "orchestrator":
         return run_orchestrator(args)
+    if args.command == "fleet":
+        return run_fleet(args)
     return 1
+
+
+def run_fleet(args) -> int:
+    """Testbed lifecycle CLI (orchestrator/src/main.rs testbed commands +
+    testbed.rs:21-210): inventory over a static host pool, ssh-backed
+    install/update/log-download."""
+    from .orchestrator.settings import Settings
+    from .orchestrator.ssh import SshManager
+    from .orchestrator.testbed import StaticProvider, Testbed
+
+    settings = Settings.load(args.settings) if args.settings else Settings()
+    pool = args.hosts if args.hosts is not None else settings.hosts
+    provider = StaticProvider(pool, state_path=args.state)
+    ssh = SshManager(pool) if pool else None
+    # settings.remote_repo's "." default addresses the ssh *runner* (commands
+    # run from the checkout); as a clone target it would hit $HOME — keep
+    # Testbed's own directory default unless the operator set a real path.
+    remote_repo = (
+        settings.remote_repo if settings.remote_repo not in ("", ".") else None
+    )
+    tb = Testbed(
+        provider,
+        ssh=ssh,
+        repo_url=settings.repo_url,
+        **({"remote_repo": remote_repo} if remote_repo else {}),
+    )
+
+    async def dispatch() -> None:
+        if args.action == "deploy":
+            await tb.deploy(args.count or len(pool), args.region)
+        elif args.action == "start":
+            await tb.start()
+        elif args.action == "stop":
+            await tb.stop()
+        elif args.action == "destroy":
+            await tb.destroy()
+        elif args.action == "status":
+            await tb.status()
+        elif args.action == "install":
+            await tb.install()
+        elif args.action == "update":
+            await tb.update()
+        elif args.action == "logs":
+            await tb.download_logs(settings.working_dir, args.dest)
+
+    asyncio.run(dispatch())
+    return 0
 
 
 def run_orchestrator(args) -> int:

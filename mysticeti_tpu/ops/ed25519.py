@@ -51,7 +51,12 @@ if jax.config.jax_compilation_cache_dir is None:
         try:
             os.makedirs(_cache, mode=0o700, exist_ok=True)
             _st = os.stat(_cache)
-            if _st.st_uid != os.getuid() or (_st.st_mode & 0o077):
+            if _st.st_uid == os.getuid():
+                if _st.st_mode & 0o077:
+                    # Our own dir with loose perms (e.g. created by an older
+                    # release): tighten in place, keep the stable shared path.
+                    os.chmod(_cache, 0o700)
+            else:
                 _cache = tempfile.mkdtemp(prefix="mysticeti-tpu-jax-cache-")
         except OSError:
             _cache = tempfile.mkdtemp(prefix="mysticeti-tpu-jax-cache-")

@@ -15,10 +15,20 @@ Modules:
                 (orchestrator/src/faults.rs)
   runner      — LocalProcessRunner + SshRunner (orchestrator.rs + ssh.rs)
   orchestrator— the benchmark lifecycle loop (orchestrator.rs:523-727)
+  ssh         — retried/parallel remote execution manager (ssh.rs:83-446)
+  testbed     — deploy/start/stop/destroy/status lifecycle + provider seam
+                (testbed.rs:21-210, client/mod.rs:68)
+  display     — colored progress/status/table console output (display.rs)
+  settings    — persisted settings.json model (settings.rs:53-96)
+  monitor     — prometheus/grafana monitoring stack deploy (monitor.rs)
+  logs        — node/client log analyzer (logs.rs:10-56)
+  plot        — latency-throughput plots (assets/plot.py)
 """
 from .benchmark import BenchmarkParameters, LoadType, ParametersGenerator
 from .faults import CrashRecoverySchedule, FaultsType
 from .measurement import Measurement, MeasurementsCollection
+from .ssh import CommandContext, SshManager
+from .testbed import Instance, ServerProvider, StaticProvider, Testbed
 
 __all__ = [
     "BenchmarkParameters",
@@ -28,4 +38,10 @@ __all__ = [
     "CrashRecoverySchedule",
     "Measurement",
     "MeasurementsCollection",
+    "CommandContext",
+    "SshManager",
+    "Instance",
+    "ServerProvider",
+    "StaticProvider",
+    "Testbed",
 ]

@@ -82,6 +82,34 @@ class LocalProcessRunner(Runner):
         self.parameters = Parameters.load(
             os.path.join(self.working_dir, "parameters.yaml")
         )
+        self._assert_ports_free()
+
+    def _assert_ports_free(self) -> None:
+        """Fail fast when another fleet holds our ports: a node that cannot
+        bind crashes AFTER genesis, and the scraper would then silently read
+        metrics from the stale process that owns the port — poisoning every
+        measurement with another run's counters."""
+        import socket
+
+        busy = []
+        for authority in range(self.committee_size):
+            for _, port in (
+                self.parameters.address(authority),
+                self.parameters.metrics_address(authority),
+            ):
+                with socket.socket() as s:
+                    # REUSEADDR matches the servers' bind semantics: sockets
+                    # in TIME_WAIT from the previous fleet are fine, only a
+                    # live listener must fail the check.
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    try:
+                        s.bind(("127.0.0.1", port))
+                    except OSError:
+                        busy.append(port)
+        if busy:
+            raise RuntimeError(
+                f"ports already in use (stale fleet?): {sorted(set(busy))}"
+            )
 
     async def boot_node(self, authority: int) -> None:
         env = dict(os.environ)

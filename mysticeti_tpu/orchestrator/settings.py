@@ -20,6 +20,7 @@ class Settings:
     runner: str = "local"  # "local" | "ssh"
     hosts: List[str] = field(default_factory=list)  # ssh: 1 per node, may be user@host
     remote_repo: str = "."  # remote checkout path for the ssh runner
+    repo_url: str = ""  # clone source for `fleet update` (settings.rs repo field)
     working_dir: str = "benchmark-fleet"
     results_dir: str = "benchmark-results"
     tps_per_node: int = 100

@@ -1,0 +1,225 @@
+"""Sampling profiler + flamegraph rendering for running nodes.
+
+Capability parity with the reference's flamegraph pipeline
+(``orchestrator/assets/mkflamegraph.sh``: perf record -F 99 -g → stackcollapse
+→ flamegraph.pl), re-imagined for a Python/JAX node: an in-process sampling
+profiler reads every thread's stack via ``sys._current_frames()`` at a fixed
+rate and aggregates *folded stacks* (the stackcollapse format), and
+:func:`flamegraph_svg` renders folded stacks straight to a self-contained
+SVG — no perf, no external scripts.
+
+Wire-up: ``MYSTICETI_PROFILE=/path/out.folded`` makes the node CLI sample
+for its whole lifetime and write the folded file at shutdown;
+``python -m tools.mkflamegraph out.folded > flame.svg`` renders it.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from collections import Counter
+from html import escape
+from typing import Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_HZ = 99.0  # the classic perf sampling rate (mkflamegraph.sh -F 99)
+
+
+class SamplingProfiler:
+    """Samples all Python threads' stacks into folded-stack counts.
+
+    The sampler thread is a daemon and costs one ``_current_frames`` walk per
+    tick (~10 µs per thread) — cheap enough to run for a whole benchmark.
+    """
+
+    def __init__(self, hz: float = DEFAULT_HZ) -> None:
+        self.interval_s = 1.0 / hz
+        self.counts: Counter = Counter()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mysticeti-profiler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+
+    def __enter__(self) -> "SamplingProfiler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- sampling --
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval_s):
+            for ident, top in sys._current_frames().items():
+                if ident == me:
+                    continue
+                frames: List[str] = []
+                frame = top
+                while frame is not None:
+                    code = frame.f_code
+                    module = os.path.splitext(os.path.basename(code.co_filename))[0]
+                    frames.append(f"{module}:{code.co_name}")
+                    frame = frame.f_back
+                if frames:
+                    self.counts[";".join(reversed(frames))] += 1
+
+    # -- output --
+
+    def folded(self) -> List[str]:
+        """Folded-stack lines, most frequent first: ``a;b;c 42``."""
+        return [f"{stack} {n}" for stack, n in self.counts.most_common()]
+
+    def write_folded(self, path: str) -> None:
+        with open(path, "w") as f:
+            for line in self.folded():
+                f.write(line + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Flamegraph rendering (flamegraph.pl equivalent)
+# ---------------------------------------------------------------------------
+
+_FRAME_H = 16
+_FONT_SIZE = 11
+_PALETTE = ("#e4572e", "#e8864a", "#f0a868", "#f6c28b", "#c96e3b", "#d88c51")
+
+
+class _Node:
+    __slots__ = ("name", "value", "children")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0
+        self.children: Dict[str, "_Node"] = {}
+
+
+def _build_trie(folded_lines: Iterable[str]) -> _Node:
+    root = _Node("all")
+    for line in folded_lines:
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count_s = line.rpartition(" ")
+        try:
+            count = int(count_s)
+        except ValueError:
+            continue
+        root.value += count
+        node = root
+        for frame in stack.split(";"):
+            child = node.children.get(frame)
+            if child is None:
+                child = node.children[frame] = _Node(frame)
+            child.value += count
+            node = child
+    return root
+
+
+def _depth(node: _Node) -> int:
+    return 1 + max((_depth(c) for c in node.children.values()), default=0)
+
+
+def flamegraph_svg(
+    folded_lines: Iterable[str],
+    title: str = "mysticeti-tpu flamegraph",
+    width: int = 1200,
+) -> str:
+    """Render folded stacks to a self-contained SVG string.
+
+    Layout matches flamegraph.pl: x = fraction of total samples, one row per
+    stack depth, alpha-ordered siblings; every rect carries a ``<title>``
+    tooltip with the frame name, sample count, and percentage.
+    """
+    root = _build_trie(folded_lines)
+    if root.value == 0:
+        root.value = 1
+    height = (_depth(root) + 1) * _FRAME_H + 40
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}"'
+        f' height="{height}" font-family="monospace" font-size="{_FONT_SIZE}">',
+        f'<text x="{width // 2}" y="20" text-anchor="middle"'
+        f' font-size="14">{escape(title)}</text>',
+    ]
+    total = root.value
+
+    def emit(node: _Node, x: float, level: int, color_idx: int) -> None:
+        w = width * node.value / total
+        if w < 0.4:
+            return
+        y = height - (level + 1) * _FRAME_H - 8
+        color = _PALETTE[color_idx % len(_PALETTE)]
+        pct = 100.0 * node.value / total
+        label = escape(node.name)
+        parts.append(
+            f'<g><title>{label} ({node.value} samples, {pct:.1f}%)</title>'
+            f'<rect x="{x:.1f}" y="{y}" width="{w:.1f}" height="{_FRAME_H - 1}"'
+            f' fill="{color}" rx="1"/>'
+        )
+        if w > 40:
+            chars = max(1, int(w / (_FONT_SIZE * 0.62)) - 1)
+            parts.append(
+                f'<text x="{x + 3:.1f}" y="{y + _FRAME_H - 5}"'
+                f' fill="#1a1a1a">{label[:chars]}</text>'
+            )
+        parts.append("</g>")
+        child_x = x
+        for i, name in enumerate(sorted(node.children)):
+            child = node.children[name]
+            emit(child, child_x, level + 1, color_idx + i + 1)
+            child_x += width * child.value / total
+
+    emit(root, 0.0, 0, 0)
+    parts.append("</svg>")
+    return "\n".join(parts)
+
+
+def render_file(folded_path: str, svg_path: Optional[str] = None) -> str:
+    """Render a folded file to SVG; returns the SVG path."""
+    with open(folded_path) as f:
+        svg = flamegraph_svg(f, title=os.path.basename(folded_path))
+    out = svg_path or folded_path.rsplit(".", 1)[0] + ".svg"
+    with open(out, "w") as f:
+        f.write(svg)
+    return out
+
+
+_active: Optional[SamplingProfiler] = None
+
+
+def start_from_env() -> Optional[SamplingProfiler]:
+    """Start lifetime profiling when ``MYSTICETI_PROFILE`` is set; the node
+    CLI calls this at boot and :func:`stop_from_env` at shutdown."""
+    global _active
+    path = os.environ.get("MYSTICETI_PROFILE")
+    if not path or _active is not None:
+        return None
+    _active = SamplingProfiler().start()
+    return _active
+
+
+def stop_from_env() -> None:
+    global _active
+    path = os.environ.get("MYSTICETI_PROFILE")
+    if _active is None or not path:
+        return
+    _active.stop()
+    _active.write_folded(path)
+    render_file(path)
+    _active = None

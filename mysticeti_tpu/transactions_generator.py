@@ -29,6 +29,7 @@ class TransactionGenerator:
         tps: int,
         transaction_size: int = TRANSACTION_SIZE_DEFAULT,
         initial_delay_s: float = 0.0,
+        ready: Optional[Callable[[], bool]] = None,
     ) -> None:
         assert transaction_size >= 16, "needs room for timestamp + nonce"
         self.submit = submit
@@ -36,6 +37,7 @@ class TransactionGenerator:
         self.tps = tps
         self.transaction_size = transaction_size
         self.initial_delay_s = initial_delay_s
+        self.ready = ready
         self._task: Optional[asyncio.Task] = None
 
     def make_batch(self, count: int) -> List[bytes]:
@@ -59,6 +61,14 @@ class TransactionGenerator:
         return self._task
 
     async def _run(self) -> None:
+        # Offered load is pointless against a node that cannot process it yet:
+        # wait for the verifier's one-time warmup (JAX trace/compile, possibly
+        # minutes when several processes share a host) before the clock-driven
+        # initial delay, so submission timestamps measure steady state and not
+        # a warmup backlog.
+        if self.ready is not None:
+            while not self.ready():
+                await asyncio.sleep(0.5)
         if self.initial_delay_s:
             await asyncio.sleep(self.initial_delay_s)
         per_tick = max(1, int(self.tps * TICK_S))

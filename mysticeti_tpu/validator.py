@@ -59,24 +59,39 @@ class CommitConsumer:
 def _make_verifier(kind: str, committee: Committee, metrics=None):
     """Signature verification is ON by default (the reference always verifies
     Ed25519 on every received block, types.rs:315-347 via net_sync.rs:352-372);
-    "accept" is an explicit consensus-only escape hatch, not a default."""
+    "accept" is an explicit consensus-only escape hatch, not a default.
+
+    The returned verifier carries a ``ready`` threading.Event: set once its
+    one-time warmup is done (immediately for cpu/accept; after the JAX
+    trace/compile for tpu).  Load generators gate on it."""
+    import threading
+
+    ready = threading.Event()
     if kind == "tpu":
         backend = TpuSignatureVerifier()
-        # Pay the JAX trace/compile (or cache load) off the hot path: blocks
-        # arriving during warmup just queue in the batching collector.
-        import threading
 
-        threading.Thread(
-            target=backend.warmup, daemon=True, name="verifier-warmup"
-        ).start()
-        return BatchedSignatureVerifier(committee, backend, metrics=metrics)
-    if kind == "cpu":
-        return BatchedSignatureVerifier(
+        def _warm() -> None:
+            # Pay the JAX trace/compile (or cache load) off the hot path:
+            # blocks arriving during warmup queue in the batching collector.
+            try:
+                backend.warmup()
+            finally:
+                ready.set()
+
+        threading.Thread(target=_warm, daemon=True, name="verifier-warmup").start()
+        verifier = BatchedSignatureVerifier(committee, backend, metrics=metrics)
+    elif kind == "cpu":
+        ready.set()
+        verifier = BatchedSignatureVerifier(
             committee, CpuSignatureVerifier(), metrics=metrics
         )
-    if kind == "accept":
-        return AcceptAllBlockVerifier()
-    raise ValueError(f"unknown verifier kind {kind!r}")
+    elif kind == "accept":
+        ready.set()
+        verifier = AcceptAllBlockVerifier()
+    else:
+        raise ValueError(f"unknown verifier kind {kind!r}")
+    verifier.ready = ready
+    return verifier
 
 
 class Validator:
@@ -153,12 +168,14 @@ class Validator:
         transaction_size = int(
             os.environ.get("TRANSACTION_SIZE", str(transaction_size))
         )
+        block_verifier = _make_verifier(verifier, committee, v.metrics)
         v.generator = TransactionGenerator(
             submit=handler.submit,
             seed=authority,
             tps=tps,
             transaction_size=transaction_size,
             initial_delay_s=float(os.environ.get("INITIAL_DELAY", "2")),
+            ready=block_verifier.ready.is_set,
         )
         if network is None:
             network = await TcpNetwork.start(
@@ -172,7 +189,7 @@ class Validator:
             observer,
             network,
             parameters=parameters,
-            block_verifier=_make_verifier(verifier, committee, v.metrics),
+            block_verifier=block_verifier,
             metrics=v.metrics,
             start_wal_sync_thread=True,
         )

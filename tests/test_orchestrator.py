@@ -151,3 +151,36 @@ def test_local_benchmark_end_to_end(tmp_path):
     assert c.benchmark_duration() > 0
     assert c.aggregate_tps() > 0, c.display_summary()
     assert os.path.exists(str(tmp_path / "results" / "measurements-0.json"))
+
+
+def test_benchmark_duration_starts_at_first_commit(tmp_path, monkeypatch):
+    """tps = count / benchmark_duration must not be diluted by pre-load
+    warmup: the duration counter opens at the FIRST committed benchmark tx
+    (reference scrapes duration from the load client, protocol/mod.rs:57-67)."""
+    import struct
+
+    from mysticeti_tpu.commit_observer import TestCommitObserver
+    from mysticeti_tpu.committee import Committee
+    from mysticeti_tpu.metrics import Metrics
+    from mysticeti_tpu.block_store import BlockStore
+    from mysticeti_tpu.wal import walf
+
+    committee = Committee.new_test([1] * 4)
+    _w, reader = walf(str(tmp_path / "wal"))
+    store = BlockStore(0, len(committee), reader)
+    metrics = Metrics()
+    observer = TestCommitObserver(store, committee, metrics=metrics)
+
+    t = [1000.0]
+    monkeypatch.setattr("mysticeti_tpu.commit_observer.time.monotonic", lambda: t[0])
+
+    # 300 s of warmup pass with no commits: duration must stay 0.
+    t[0] += 300.0
+    tx = struct.pack("<d", 0.0) + b"\0" * 24
+    observer._update_metrics(tx, now=0.0)
+    assert metrics.benchmark_duration._value.get() == 0.0
+
+    # 20 s into the loaded phase the counter reflects loaded time only.
+    t[0] += 20.0
+    observer._update_metrics(tx, now=0.0)
+    assert metrics.benchmark_duration._value.get() == 20.0

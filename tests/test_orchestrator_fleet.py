@@ -1,0 +1,194 @@
+"""Fleet-management periphery: display, ssh manager, testbed lifecycle,
+profiling/flamegraph.
+
+Reference tiers covered: display.rs (table/status output), ssh.rs:83-272
+(CommandContext composition, retries, parallel fan-out), testbed.rs:21-210
+(deploy/start/stop/destroy/status), client/mod.rs:68 (provider seam),
+assets/mkflamegraph.sh (profile -> folded -> svg pipeline).  The ssh tests
+inject a fake transport at the `_spawn` seam instead of needing a live sshd.
+"""
+import asyncio
+import io
+import os
+import time
+
+import pytest
+
+from mysticeti_tpu.orchestrator.display import format_table, progress, status
+from mysticeti_tpu.orchestrator.ssh import CommandContext, SshError, SshManager
+from mysticeti_tpu.orchestrator.testbed import (
+    Instance,
+    StaticProvider,
+    Testbed,
+)
+from mysticeti_tpu.profiling import SamplingProfiler, flamegraph_svg
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# display
+# ---------------------------------------------------------------------------
+
+
+def test_format_table_alignment():
+    table = format_table(["id", "host"], [["i-0", "10.0.0.1"], ["i-11", "x"]])
+    lines = table.splitlines()
+    assert len({len(l) for l in lines}) == 1  # rectangular
+    assert "i-11" in table and "10.0.0.1" in table
+
+
+def test_status_and_progress_plain_streams():
+    buf = io.StringIO()
+    status("hello", stream=buf)
+    progress(3, 10, "scrapes", stream=buf)
+    out = buf.getvalue()
+    assert "hello" in out and "3/10 scrapes" in out
+    assert "\x1b[" not in out  # no ANSI on non-tty
+
+
+# ---------------------------------------------------------------------------
+# ssh
+# ---------------------------------------------------------------------------
+
+
+def test_command_context_compose():
+    ctx = CommandContext(path="/opt/repo", env={"TPS": "100"})
+    assert ctx.apply("python3 -m mysticeti_tpu run") == (
+        "cd /opt/repo && TPS=100 python3 -m mysticeti_tpu run"
+    )
+
+
+def test_command_context_background_pidfile():
+    ctx = CommandContext(background="node-3", log_file="/tmp/n3.log")
+    cmd = ctx.apply("sleep 60")
+    assert "setsid nohup" in cmd
+    assert "/tmp/.mysticeti-session-node-3.pid" in cmd
+    assert "> /tmp/n3.log" in cmd
+
+
+class FlakyTransport(SshManager):
+    """Fails the first N spawns, then succeeds; records every argv."""
+
+    def __init__(self, hosts, fail_first=0, **kw):
+        kw.setdefault("retry_delay_s", 0.0)
+        super().__init__(hosts, **kw)
+        self.fail_first = fail_first
+        self.calls = []
+
+    async def _spawn(self, argv, timeout_s):
+        self.calls.append(argv)
+        if len(self.calls) <= self.fail_first:
+            return 255, b"connection refused"
+        return 0, f"ok:{argv[-2]}".encode()
+
+
+def test_ssh_retries_then_succeeds():
+    mgr = FlakyTransport(["h0"], fail_first=2, retries=3)
+    out = run(mgr.execute("h0", "true"))
+    assert out.startswith("ok:")
+    assert len(mgr.calls) == 3
+
+
+def test_ssh_raises_after_final_retry():
+    mgr = FlakyTransport(["h0"], fail_first=99, retries=2)
+    with pytest.raises(SshError, match="exit 255"):
+        run(mgr.execute("h0", "true"))
+    assert len(mgr.calls) == 2
+
+
+def test_ssh_parallel_fanout_all_hosts():
+    hosts = [f"h{i}" for i in range(5)]
+    mgr = FlakyTransport(hosts)
+    outs = run(mgr.execute_all("uptime"))
+    assert len(outs) == 5
+    # every host saw the command
+    assert {argv[-2] for argv in mgr.calls} == set(hosts)
+
+
+# ---------------------------------------------------------------------------
+# testbed
+# ---------------------------------------------------------------------------
+
+
+def test_static_provider_lifecycle(tmp_path):
+    state = str(tmp_path / "testbed.json")
+    provider = StaticProvider(["10.0.0.1", "10.0.0.2", "10.0.0.3"], state)
+    tb = Testbed(provider)
+
+    created = run(tb.deploy(2, "local"))
+    assert [i.host for i in created] == ["10.0.0.1", "10.0.0.2"]
+
+    run(tb.stop())
+    assert all(not i.active for i in run(provider.list_instances()))
+    run(tb.start())
+    assert all(i.active for i in run(provider.list_instances()))
+
+    # state survives re-load (testbed.rs keeps this in cloud tags)
+    provider2 = StaticProvider(["10.0.0.1", "10.0.0.2", "10.0.0.3"], state)
+    assert [i.host for i in run(provider2.list_instances())] == [
+        "10.0.0.1",
+        "10.0.0.2",
+    ]
+
+    run(tb.destroy())
+    assert run(provider.list_instances()) == []
+
+
+def test_static_provider_pool_exhausted(tmp_path):
+    provider = StaticProvider(["only-one"], str(tmp_path / "s.json"))
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        run(provider.create_instances(2, "local"))
+
+
+def test_testbed_install_update_over_fake_ssh(tmp_path):
+    provider = StaticProvider(["h0", "h1"], str(tmp_path / "s.json"))
+    run(provider.create_instances(2, "local"))
+    ssh = FlakyTransport(["h0", "h1"])
+    tb = Testbed(provider, ssh=ssh, repo_url="https://example.com/repo.git")
+    run(tb.install())
+    run(tb.update())
+    joined = [" ".join(argv) for argv in ssh.calls]
+    assert any("git clone" in c or "git -C" in c for c in joined)
+    assert {argv[-2] for argv in ssh.calls} == {"h0", "h1"}
+
+
+# ---------------------------------------------------------------------------
+# profiling / flamegraph
+# ---------------------------------------------------------------------------
+
+
+def _busy(deadline):
+    x = 0
+    while time.perf_counter() < deadline:
+        x += sum(range(200))
+    return x
+
+
+def test_sampling_profiler_captures_busy_function():
+    prof = SamplingProfiler(hz=200)
+    with prof:
+        _busy(time.perf_counter() + 0.4)
+    folded = prof.folded()
+    assert folded, "no samples collected"
+    assert any("_busy" in line for line in folded)
+    # folded format: "a;b;c N"
+    stack, _, count = folded[0].rpartition(" ")
+    assert int(count) >= 1 and ";" in stack or ":" in stack
+
+
+def test_flamegraph_svg_renders(tmp_path):
+    folded = ["main;work;inner 60", "main;work;other 30", "main;idle 10"]
+    svg = flamegraph_svg(folded)
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    assert "work" in svg and "inner" in svg
+    assert svg.count("<rect") >= 5  # root + 5 frames, minus tiny ones
+    # end-to-end file pipeline
+    from mysticeti_tpu.profiling import render_file
+
+    src = tmp_path / "x.folded"
+    src.write_text("\n".join(folded) + "\n")
+    out = render_file(str(src))
+    assert out.endswith(".svg") and os.path.exists(out)

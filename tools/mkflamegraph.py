@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Render a folded-stacks file (mysticeti_tpu.profiling / stackcollapse
+format) to a self-contained flamegraph SVG.
+
+Usage:
+    python tools/mkflamegraph.py node.folded [out.svg]
+
+Equivalent of the reference's ``orchestrator/assets/mkflamegraph.sh`` with
+the perf+flamegraph.pl pipeline replaced by the in-repo renderer.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mysticeti_tpu.profiling import render_file  # noqa: E402
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    out = render_file(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
