@@ -123,28 +123,30 @@ class MeasurementsCollection:
         return max((m.benchmark_duration_s for m in last), default=0.0)
 
     def aggregate_tps(self) -> float:
-        """Sum of per-validator counts over the max duration (measurement.rs:236-250)."""
+        """MAX of per-validator tps over the common duration
+        (measurement.rs:236-250 takes ``.map(tps).max()``): every validator
+        observes every committed shared tx, so per-scraper counts are N
+        views of the same total — summing them would report N× the system
+        throughput."""
         duration = self.benchmark_duration()
         if duration == 0:
             return 0.0
-        total = sum(m.count for m in self._last_measurements())
-        return total / duration
+        return max(
+            (m.count / duration for m in self._last_measurements()), default=0.0
+        )
 
     def aggregate_average_latency_s(self) -> float:
-        last = self._last_measurements()
-        count = sum(m.count for m in last)
-        if not count:
+        """Mean of per-validator average latencies (measurement.rs:253-262)."""
+        last = [m for m in self._last_measurements() if m.count]
+        if not last:
             return 0.0
-        return sum(m.sum_s for m in last) / count
+        return sum(m.avg_latency_s() for m in last) / len(last)
 
     def aggregate_stdev_latency_s(self) -> float:
-        last = self._last_measurements()
-        count = sum(m.count for m in last)
-        if not count:
-            return 0.0
-        first = sum(m.squared_sum_s for m in last) / count
-        second = self.aggregate_average_latency_s() ** 2
-        return math.sqrt(max(0.0, first - second))
+        """MAX of per-validator latency stdevs (measurement.rs:265-272)."""
+        return max(
+            (m.stdev_latency_s() for m in self._last_measurements()), default=0.0
+        )
 
     def save(self, path: str) -> None:
         data = {

@@ -78,6 +78,15 @@ class LocalProcessRunner(Runner):
             # The sweep's offered load for THIS run, split across the committee
             # (protocol/mysticeti.rs:116 passes TPS the same way).
             self.tps_per_node = max(1, load_tx_s // committee_size)
+        # Wipe per-validator state from any previous run (orchestrator.rs
+        # cleanup step): genesis regenerates keys, so a stale WAL replayed
+        # into the fresh committee fails verification wholesale — every block
+        # suspends and the run drowns in sync traffic instead of committing.
+        import glob
+        import shutil
+
+        for path in glob.glob(os.path.join(self.working_dir, "validator-*")):
+            shutil.rmtree(path, ignore_errors=True)
         benchmark_genesis(["127.0.0.1"] * committee_size, self.working_dir)
         self.parameters = Parameters.load(
             os.path.join(self.working_dir, "parameters.yaml")

@@ -46,12 +46,12 @@ def test_measurements_collection_aggregation(tmp_path):
     c = MeasurementsCollection({"nodes": 2})
     for node in ("0", "1"):
         c.add(node, Measurement.from_prometheus(SCRAPE, "shared"))
-    assert c.aggregate_tps() == pytest.approx(4.0)  # 200 tx over max 50 s
+    assert c.aggregate_tps() == pytest.approx(2.0)  # max per-node view: 100 tx / 50 s
     assert c.aggregate_average_latency_s() == pytest.approx(0.315)
     path = str(tmp_path / "m.json")
     c.save(path)
     loaded = MeasurementsCollection.load(path)
-    assert loaded.aggregate_tps() == pytest.approx(4.0)
+    assert loaded.aggregate_tps() == pytest.approx(2.0)
     assert "tps" in loaded.display_summary()
 
 
