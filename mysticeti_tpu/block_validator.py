@@ -89,8 +89,15 @@ class TpuSignatureVerifier(SignatureVerifier):
     ``jax.sharding.Mesh`` or ``None`` to override.
     """
 
-    def __init__(self, mesh="auto") -> None:
+    def __init__(self, mesh="auto", committee_keys=None) -> None:
         self._mesh = mesh
+        # Known signer set -> device-resident key table: the pk rides as an
+        # index (26 words/sig on the wire instead of 33), uploaded once.
+        self._table = None
+        if committee_keys:
+            from .ops.ed25519 import KeyTable
+
+            self._table = KeyTable(list(committee_keys))
 
     def _resolve_mesh(self):
         if self._mesh == "auto":
@@ -119,6 +126,13 @@ class TpuSignatureVerifier(SignatureVerifier):
         # other lengths fall back to the single-device host-hash path so the
         # result never depends on the device count.
         if mesh is not None and all(len(d) == 32 for d in digests):
+            if self._table is not None:
+                from .parallel.mesh import sharded_verify_batch_indexed
+
+                ok, _ = sharded_verify_batch_indexed(
+                    mesh, self._table, public_keys, digests, signatures
+                )
+                return list(ok)
             from .parallel.mesh import sharded_verify_batch_fused
 
             ok, _ = sharded_verify_batch_fused(
@@ -127,6 +141,12 @@ class TpuSignatureVerifier(SignatureVerifier):
             return list(ok)
         from .ops import ed25519
 
+        if self._table is not None:
+            return list(
+                ed25519.verify_batch_table(
+                    self._table, public_keys, digests, signatures
+                )
+            )
         return list(ed25519.verify_batch(public_keys, digests, signatures))
 
 

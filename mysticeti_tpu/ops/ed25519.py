@@ -370,6 +370,22 @@ def verify_fused_impl(msg_words, s_words, host_ok) -> jnp.ndarray:
 verify_fused_kernel = jax.jit(verify_fused_impl)
 
 
+def _pack_fixed_rows(items: Sequence[bytes], width: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(n, width) uint8 rows + per-row well-formedness.  Vectorized single
+    concatenation when every item has the right length; rows of wrong length
+    zero-fill (callers mask them via host_ok — verify-returns-False
+    semantics, never an exception)."""
+    n = len(items)
+    ok = np.fromiter((len(x) == width for x in items), bool, count=n)
+    if ok.all():
+        return np.frombuffer(b"".join(items), np.uint8).reshape(n, width), ok
+    arr = np.zeros((n, width), np.uint8)
+    for i in range(n):
+        if ok[i]:
+            arr[i] = np.frombuffer(items[i], np.uint8)
+    return arr, ok
+
+
 def pack_bytes(
     public_keys: Sequence[bytes],
     messages: Sequence[bytes],
@@ -381,30 +397,10 @@ def pack_bytes(
     digest, types.py signed_digest); malformed-length items are masked out via
     host_ok rather than raising, matching verify-returns-False semantics.
     """
-    n = len(signatures)
-    host_ok = np.ones(n, bool)
-    well_formed = True
-    for i in range(n):
-        if (
-            len(public_keys[i]) != 32
-            or len(messages[i]) != 32
-            or len(signatures[i]) != 64
-        ):
-            host_ok[i] = False
-            well_formed = False
-    if well_formed:
-        sig_arr = np.frombuffer(b"".join(signatures), np.uint8).reshape(n, 64)
-        pk_arr = np.frombuffer(b"".join(public_keys), np.uint8).reshape(n, 32)
-        msg_arr = np.frombuffer(b"".join(messages), np.uint8).reshape(n, 32)
-    else:
-        sig_arr = np.zeros((n, 64), np.uint8)
-        pk_arr = np.zeros((n, 32), np.uint8)
-        msg_arr = np.zeros((n, 32), np.uint8)
-        for i in range(n):
-            if host_ok[i]:
-                sig_arr[i] = np.frombuffer(signatures[i], np.uint8)
-                pk_arr[i] = np.frombuffer(public_keys[i], np.uint8)
-                msg_arr[i] = np.frombuffer(messages[i], np.uint8)
+    sig_arr, sig_ok = _pack_fixed_rows(signatures, 64)
+    pk_arr, pk_ok = _pack_fixed_rows(public_keys, 32)
+    msg_arr, msg_ok = _pack_fixed_rows(messages, 32)
+    host_ok = sig_ok & pk_ok & msg_ok
     blob = np.ascontiguousarray(
         np.concatenate([sig_arr[:, :32], pk_arr, msg_arr], axis=1)
     )
@@ -438,6 +434,164 @@ def verify_fused_blob_impl(blob: jnp.ndarray) -> jnp.ndarray:
 
 
 verify_fused_blob_kernel = jax.jit(verify_fused_blob_impl)
+
+
+# ---------------------------------------------------------------------------
+# Indexed path: the signer set is a known committee, so the public key rides
+# as an INDEX into a device-resident key table instead of 32 raw bytes —
+# 26 words/sig on the wire instead of 33 (~21% less host->device transfer,
+# the binding resource on remote/tunneled chips).  The table is uploaded once
+# per committee.
+# ---------------------------------------------------------------------------
+
+
+def pk_table_words(public_keys: Sequence[bytes]) -> np.ndarray:
+    """(K, 8) uint32 big-endian words of the raw 32-byte A encodings — the
+    exact layout the fused blob carries in its A section."""
+    arr = np.frombuffer(b"".join(public_keys), np.uint8).reshape(
+        len(public_keys), 32
+    )
+    return np.ascontiguousarray(arr).view(">u4").astype(np.uint32)
+
+
+def pack_blob_indexed(
+    indices: np.ndarray,
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    host_ok: Optional[np.ndarray] = None,
+    num_keys: Optional[int] = None,
+) -> np.ndarray:
+    """Pack a batch into ONE (n, 26) uint32 array: columns 0-7 big-endian R
+    words, 8-15 big-endian M words, 16-23 little-endian s words, 24 the key
+    index, 25 the host_ok flag.
+
+    Out-of-range indices (including the -1 "unknown key" sentinel from
+    ``KeyTable.indices_for``) are masked host_ok=False here — never silently
+    verified against some other table row.
+    """
+    n = len(signatures)
+    idx = np.asarray(indices, np.int64)
+    ok = np.ones(n, bool) if host_ok is None else np.asarray(host_ok, bool).copy()
+    ok &= idx >= 0
+    if num_keys is not None:
+        ok &= idx < num_keys
+    sig_arr, sig_ok = _pack_fixed_rows(signatures, 64)
+    msg_arr, msg_ok = _pack_fixed_rows(messages, 32)
+    ok &= sig_ok & msg_ok
+    rm = np.ascontiguousarray(
+        np.concatenate([sig_arr[:, :32], msg_arr], axis=1)
+    )
+    rm_words = rm.view(">u4").astype(np.uint32)  # (n, 16) R then M
+    s_words = np.ascontiguousarray(sig_arr[:, 32:]).view("<u4").astype(np.uint32)
+    return np.concatenate(
+        [
+            rm_words,
+            s_words,
+            np.clip(idx, 0, None).astype(np.uint32)[:, None],
+            ok[:, None].astype(np.uint32),
+        ],
+        axis=1,
+    )
+
+
+def indexed_to_msg_words(blob: jnp.ndarray, table: jnp.ndarray):
+    """Rebuild the fused-kernel inputs from an indexed blob + key table:
+    gather the A words by index and splice them between R and M."""
+    idx = blob[..., 24].astype(jnp.int32)
+    a_words = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+    msg_words = jnp.concatenate(
+        [blob[..., :8], a_words, blob[..., 8:16]], axis=-1
+    )
+    return msg_words, blob[..., 16:24], blob[..., 25] != 0
+
+
+def verify_fused_indexed_impl(blob: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """(B, 26) indexed blob + (K, 8) key table -> (B,) bool."""
+    return verify_fused_impl(*indexed_to_msg_words(blob, table))
+
+
+verify_fused_indexed_kernel = jax.jit(verify_fused_indexed_impl)
+
+
+class KeyTable:
+    """A committee's keys resident on device: upload once, verify by index.
+
+    ``indices_for`` maps raw pk bytes to table rows; unknown keys map to -1
+    (callers mask them out or route them through the generic path)."""
+
+    def __init__(self, public_keys: Sequence[bytes]) -> None:
+        if not public_keys:
+            raise ValueError("empty key table")
+        if any(len(pk) != 32 for pk in public_keys):
+            raise ValueError("key table entries must be 32-byte encodings")
+        self.words = jnp.asarray(pk_table_words(public_keys))
+        self._index = {pk: i for i, pk in enumerate(public_keys)}
+
+    def __len__(self) -> int:
+        return self.words.shape[0]
+
+    def indices_for(self, public_keys: Sequence[bytes]) -> np.ndarray:
+        return np.fromiter(
+            (self._index.get(pk, -1) for pk in public_keys),
+            np.int64,
+            count=len(public_keys),
+        )
+
+
+def _dispatch_indexed(blob, table) -> jnp.ndarray:
+    if _backend() == "pallas":
+        from . import ed25519_pallas as PK
+
+        return PK.verify_fused_indexed_blob_pallas(blob, table)
+    return verify_fused_indexed_kernel(blob, table)
+
+
+def dispatch_indexed_chunks(blob: np.ndarray, table: "KeyTable"):
+    """Bucket-shaped async dispatch of an indexed blob (pack_blob_indexed
+    layout); returns [(count, handle)] for fetch_handles."""
+    return [
+        (
+            count,
+            _dispatch_indexed(
+                jnp.asarray(_pad_to(blob[start : start + count], b)), table.words
+            ),
+        )
+        for start, count, b in iter_buckets(blob.shape[0])
+    ]
+
+
+def verify_batch_table(
+    table: "KeyTable",
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> np.ndarray:
+    """verify_batch against a known signer set: per-sig transfer drops to 26
+    words.  Items whose pk is not in the table fall back to the generic path
+    (correctness is identical; only the wire format differs)."""
+    n = len(signatures)
+    if n == 0:
+        return np.zeros(0, bool)
+    if not all(len(m) == 32 for m in messages):
+        return verify_batch(public_keys, messages, signatures)
+    idx = table.indices_for(public_keys)
+    known = idx >= 0
+    out = np.zeros(n, bool)
+    if known.all():
+        blob = pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
+        return fetch_handles(dispatch_indexed_chunks(blob, table))
+    blob = pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
+    handles = dispatch_indexed_chunks(blob, table)
+    stragglers = [i for i in range(n) if not known[i]]
+    generic = verify_batch(
+        [public_keys[i] for i in stragglers],
+        [messages[i] for i in stragglers],
+        [signatures[i] for i in stragglers],
+    )
+    out[:] = fetch_handles(handles)
+    for j, i in enumerate(stragglers):
+        out[i] = generic[j]
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -490,6 +490,13 @@ def _verify_fused_blob_pallas_jit(blob, *, tile, interpret):
     return _verify_pallas_jit(*args, tile=tile, interpret=interpret)
 
 
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_fused_indexed_pallas_jit(blob, table, *, tile, interpret):
+    # Key-table gather + splice in XLA (trivial), everything else as above.
+    args = E.prepare_fused(*E.indexed_to_msg_words(blob, table))
+    return _verify_pallas_jit(*args, tile=tile, interpret=interpret)
+
+
 def verify_fused_blob_pallas(
     blob, *, tile: Optional[int] = None, interpret: Optional[bool] = None
 ) -> jnp.ndarray:
@@ -504,6 +511,23 @@ def verify_fused_blob_pallas(
         raise ValueError(f"batch {b} not a multiple of tile {tile}")
     return _verify_fused_blob_pallas_jit(
         jnp.asarray(blob), tile=tile, interpret=interpret
+    )
+
+
+def verify_fused_indexed_blob_pallas(
+    blob, table, *, tile: Optional[int] = None, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Indexed-blob fused verification (ops.ed25519.pack_blob_indexed layout +
+    device-resident key table): minimum wire bytes, Pallas ladder."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile is None:
+        tile = default_tile()
+    b = blob.shape[0]
+    if b % tile != 0:
+        raise ValueError(f"batch {b} not a multiple of tile {tile}")
+    return _verify_fused_indexed_pallas_jit(
+        jnp.asarray(blob), jnp.asarray(table), tile=tile, interpret=interpret
     )
 
 

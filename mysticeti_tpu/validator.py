@@ -68,7 +68,12 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
 
     ready = threading.Event()
     if kind == "tpu":
-        backend = TpuSignatureVerifier()
+        backend = TpuSignatureVerifier(
+            committee_keys=[
+                committee.get_public_key(a).bytes
+                for a in range(len(committee))
+            ]
+        )
 
         def _warm() -> None:
             # Pay the JAX trace/compile (or cache load) off the hot path:

@@ -1,0 +1,98 @@
+"""Committee-indexed verification path: KeyTable + pack_blob_indexed +
+verify_batch_table must be bit-identical to the generic fused path (and to
+the CPU oracle) — only the wire format differs.
+
+The signer set of a validator is its committee, so the public key rides as an
+index into a device-resident table (26 words/sig instead of 33).  Reference
+unit of work: crypto.rs:174-189 (full SHA-512 + Ed25519 verify per block).
+"""
+import random
+
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from mysticeti_tpu.ops import ed25519 as E
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def keyring():
+    rng = random.Random(99)
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.randrange(256) for _ in range(32))
+        )
+        for _ in range(6)
+    ]
+    return rng, keys
+
+
+def _batch(rng, keys, n, tamper_every=None):
+    pks, msgs, sigs, expect = [], [], [], []
+    for i in range(n):
+        k = keys[i % len(keys)]
+        m = bytes(rng.randrange(256) for _ in range(32))
+        s = k.sign(m)
+        good = True
+        if tamper_every and i % tamper_every == 0:
+            s = bytes([s[0] ^ 1]) + s[1:]
+            good = False
+        pks.append(k.public_key().public_bytes_raw())
+        msgs.append(m)
+        sigs.append(s)
+        expect.append(good)
+    return pks, msgs, sigs, np.array(expect)
+
+
+def test_indexed_matches_generic_and_expected(keyring):
+    rng, keys = keyring
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys])
+    pks, msgs, sigs, expect = _batch(rng, keys, 200, tamper_every=9)
+    out = E.verify_batch_table(table, pks, msgs, sigs)
+    assert (out == expect).all()
+    assert (out == E.verify_batch(pks, msgs, sigs)).all()
+
+
+def test_indexed_unknown_pk_falls_back(keyring):
+    rng, keys = keyring
+    # table misses the last key: its items route through the generic path
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys[:-1]])
+    pks, msgs, sigs, expect = _batch(rng, keys, 60, tamper_every=7)
+    out = E.verify_batch_table(table, pks, msgs, sigs)
+    assert (out == expect).all()
+
+
+def test_indexed_rejects_malformed_lengths(keyring):
+    rng, keys = keyring
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys])
+    pks, msgs, sigs, expect = _batch(rng, keys, 8)
+    msgs[3] = b"short"
+    sigs[5] = sigs[5][:-1]
+    out = E.verify_batch_table(table, pks, msgs, sigs)
+    expect[3] = expect[5] = False
+    assert (out == expect).all()
+
+
+def test_key_table_validation():
+    with pytest.raises(ValueError):
+        E.KeyTable([])
+    with pytest.raises(ValueError):
+        E.KeyTable([b"too-short"])
+
+
+def test_indexed_blob_layout(keyring):
+    rng, keys = keyring
+    pk = keys[0].public_key().public_bytes_raw()
+    m = bytes(range(32))
+    s = keys[0].sign(m)
+    blob = E.pack_blob_indexed(np.array([4]), [m], [s])
+    assert blob.shape == (1, 26) and blob.dtype == np.uint32
+    assert blob[0, 24] == 4 and blob[0, 25] == 1
+    # R words are the big-endian view of the first 32 sig bytes
+    want_r = np.frombuffer(s[:32], ">u4").astype(np.uint32)
+    assert (blob[0, :8] == want_r).all()
+    # M words big-endian, s words little-endian
+    assert (blob[0, 8:16] == np.frombuffer(m, ">u4").astype(np.uint32)).all()
+    assert (blob[0, 16:24] == np.frombuffer(s[32:], "<u4").astype(np.uint32)).all()

@@ -69,3 +69,40 @@ def test_sharded_fused_verify_matches_oracle():
     got, total = sharded_verify_batch_fused(mesh, pks, msgs, sigs)
     assert list(got) == expect
     assert total == sum(expect)
+
+
+def test_sharded_indexed_verify_matches_oracle():
+    """Committee-indexed sharded path (table replicated, blob sharded):
+    bit-identical to the generic sharded path incl. unknown-key fallback."""
+    import random
+
+    from mysticeti_tpu.ops import ed25519 as E
+    from mysticeti_tpu.parallel.mesh import (
+        make_mesh,
+        sharded_verify_batch_fused,
+        sharded_verify_batch_indexed,
+    )
+
+    rng = random.Random(17)
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.randrange(256) for _ in range(32))
+        )
+        for _ in range(5)
+    ]
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys[:4]])
+    pks, msgs, sigs = [], [], []
+    for i in range(64):
+        k = keys[i % 5]  # key 4 is unknown to the table
+        m = bytes(rng.randrange(256) for _ in range(32))
+        s = k.sign(m)
+        if i % 6 == 0:
+            s = bytes([s[0] ^ 1]) + s[1:]
+        pks.append(k.public_key().public_bytes_raw())
+        msgs.append(m)
+        sigs.append(s)
+    mesh = make_mesh(8)
+    ok_idx, total_idx = sharded_verify_batch_indexed(mesh, table, pks, msgs, sigs)
+    ok_gen, total_gen = sharded_verify_batch_fused(mesh, pks, msgs, sigs)
+    assert (ok_idx == ok_gen).all()
+    assert total_idx == total_gen == int(ok_gen.sum())
