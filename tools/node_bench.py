@@ -107,7 +107,15 @@ def saturation(verifier: str, batch: int = 4096, iters: int = 5) -> dict:
         pks.append(k.public_key().public_bytes_raw())
         msgs.append(m)
         sigs.append(k.sign(m))
-    backend = CpuSignatureVerifier() if verifier == "cpu" else TpuSignatureVerifier()
+    backend = (
+        CpuSignatureVerifier()
+        if verifier == "cpu"
+        # Deployed semantics: the signer set is the committee, keys ride as
+        # indices into a device-resident table (validator._make_verifier).
+        else TpuSignatureVerifier(
+            committee_keys=[k.public_key().public_bytes_raw() for k in keys]
+        )
+    )
     assert all(backend.verify_signatures(pks, msgs, sigs))  # warm
     t0 = time.perf_counter()
     for _ in range(iters):
