@@ -161,6 +161,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     o.add_argument("--search", action="store_true",
                    help="binary-search the max sustainable load instead")
     o.add_argument("--starting-load", type=int, default=100)
+    o.add_argument("--max-iterations", type=int, default=7,
+                   help="search: probe budget (doubling + bisection runs)")
     o.add_argument("--duration", type=float, default=60.0)
     o.add_argument("--faults", type=int, default=0)
     o.add_argument("--fault-kind", choices=["none", "permanent", "crash-recovery"],
@@ -318,7 +320,7 @@ def run_orchestrator(args) -> int:
         faults = FaultsType.none()
 
     load_type = (
-        LoadType.search(args.starting_load)
+        LoadType.search(args.starting_load, max_iterations=args.max_iterations)
         if args.search
         else LoadType.fixed(list(args.loads))
     )

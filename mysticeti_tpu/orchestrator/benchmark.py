@@ -131,13 +131,15 @@ class ParametersGenerator:
         if not over:
             self._previous_latency = collection.aggregate_average_latency_s()
         self._iterations += 1
-        if self._iterations >= self.load_type.max_iterations:
-            self._done = True
-            return
+        # Record this probe's bound BEFORE the iteration cutoff: a run whose
+        # final probe sustains must count toward max_sustainable_load.
         if over:
             self._search_upper = parameters.load
         else:
             self._search_lower = parameters.load
+        if self._iterations >= self.load_type.max_iterations:
+            self._done = True
+            return
         if self._search_upper is None:
             self._search_current = parameters.load * 2  # still probing upward
         else:
