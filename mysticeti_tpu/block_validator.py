@@ -21,7 +21,10 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .committee import Committee
+from .tracing import logger
 from .types import StatementBlock, VerificationError
+
+log = logger(__name__)
 
 
 class BlockVerifier:
@@ -221,6 +224,8 @@ class BatchedSignatureVerifier(BlockVerifier):
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting
             # connection tasks forever — fail every future in the batch.
+            log.error("signature verifier crashed on %d blocks: %r",
+                      len(batch), exc)
             for _, future in batch:
                 if not future.done():
                     future.set_exception(

@@ -45,6 +45,7 @@ from .epoch_close import EpochManager
 from .serde import Writer
 from .state import CoreRecoveredState, Include, MetaStatement, Payload, encode_payload
 from .threshold_clock import ThresholdClockAggregator
+from .tracing import logger
 from .types import (
     AuthorityIndex,
     AuthoritySet,
@@ -53,6 +54,8 @@ from .types import (
     StatementBlock,
 )
 from .wal import POSITION_MAX, WalPosition, WalSyncer, WalWriter
+
+log = logger(__name__)
 
 
 class CoreOptions:
@@ -223,6 +226,12 @@ class Core:
         )
         if self.options.fsync:
             self.wal_writer.sync()
+        log.debug(
+            "proposed block round=%d includes=%d statements=%d",
+            block.round(),
+            len(block.includes),
+            len(block.statements),
+        )
         return block
 
     # -- commit (core.rs:368-385) --

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence
 from .block_store import BlockStore
 from .config import SynchronizerParameters
 from .core_task import CoreTaskDispatcher
+from .tracing import logger
 from .network import (
     BlockNotFound,
     Blocks,
@@ -25,6 +26,9 @@ from .network import (
     RequestBlocksResponse,
 )
 from .types import BlockReference, RoundNumber
+
+
+log = logger(__name__)
 
 MAXIMUM_BLOCK_REQUEST = 50  # net_sync.rs:30
 DISSEMINATION_CHUNK = 10  # synchronizer.rs:74 send_blocks chunking
@@ -141,6 +145,11 @@ class BlockFetcher:
                 peer = self._sample_peer(exclude={self.authority})
                 if peer is None:
                     break
+                log.debug(
+                    "fetching %d missing blocks from authority %d",
+                    len(chunk),
+                    peer,
+                )
                 await self.connections[peer].send(RequestBlocks(tuple(chunk)))
 
     def _sample_peer(self, exclude) -> Optional[int]:
