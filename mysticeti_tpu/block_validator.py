@@ -182,6 +182,16 @@ class BatchedSignatureVerifier(BlockVerifier):
         self._pending: List[Tuple[StatementBlock, asyncio.Future]] = []
         self._lock = threading.Lock()
         self._flush_task: Optional[asyncio.TimerHandle] = None
+        # EMA of observed dispatch latency: when the accelerator is far away
+        # (tunneled/remote chip, ~100 ms+ per dispatch), a 5 ms collection
+        # window dispatches tiny batches back-to-back and the queue of
+        # round-trips becomes the latency — waiting a fraction of the
+        # measured RTT instead coalesces them at a bounded (~20%) cost on a
+        # latency already dominated by that RTT.
+        self._dispatch_ema_s = 0.0
+
+    def _effective_delay_s(self) -> float:
+        return max(self.max_delay_s, 0.2 * self._dispatch_ema_s)
 
     async def verify(self, block: StatementBlock) -> None:
         loop = asyncio.get_running_loop()
@@ -193,7 +203,8 @@ class BatchedSignatureVerifier(BlockVerifier):
                 flush_now = True
             elif self._flush_task is None:
                 self._flush_task = loop.call_later(
-                    self.max_delay_s, lambda: asyncio.ensure_future(self._flush())
+                    self._effective_delay_s(),
+                    lambda: asyncio.ensure_future(self._flush()),
                 )
         if flush_now:
             await self._flush()
@@ -217,9 +228,16 @@ class BatchedSignatureVerifier(BlockVerifier):
         digests = [b.signed_digest() for b in blocks]
         sigs = [b.signature for b in blocks]
         loop = asyncio.get_running_loop()
+        started = time.monotonic()
         try:
             results = await loop.run_in_executor(
                 None, self.verifier.verify_signatures, pks, digests, sigs
+            )
+            elapsed = time.monotonic() - started
+            self._dispatch_ema_s = (
+                elapsed
+                if self._dispatch_ema_s == 0.0
+                else 0.8 * self._dispatch_ema_s + 0.2 * elapsed
             )
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting

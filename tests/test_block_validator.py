@@ -137,3 +137,39 @@ def test_validators_with_cpu_signature_verification(tmp_path):
                 await v.stop()
 
     asyncio.run(main())
+
+
+def test_adaptive_batching_window_tracks_dispatch_latency():
+    """A remote accelerator (~100ms/dispatch) must widen the collection
+    window to a fraction of the observed dispatch latency, so back-to-back
+    tiny dispatches don't queue; a fast verifier keeps the 5ms floor."""
+    import asyncio
+    import time as _time
+
+    from mysticeti_tpu.block_validator import (
+        BatchedSignatureVerifier,
+        SignatureVerifier,
+    )
+    from mysticeti_tpu.committee import Committee
+
+    class SlowVerifier(SignatureVerifier):
+        def verify_signatures(self, pks, digests, sigs):
+            _time.sleep(0.05)
+            return [True] * len(sigs)
+
+    committee = Committee.new_for_benchmarks(4)
+    signers = Committee.benchmark_signers(4)
+    from mysticeti_tpu.types import Share, StatementBlock
+
+    genesis = [StatementBlock.new_genesis(i).reference for i in range(4)]
+    blk = StatementBlock.build(0, 1, genesis, [Share(b"tx")], signer=signers[0])
+
+    async def main():
+        v = BatchedSignatureVerifier(committee, SlowVerifier(), max_delay_s=0.005)
+        assert v._effective_delay_s() == 0.005  # floor before any dispatch
+        await v.verify(blk)
+        await v.flush_now()
+        assert v._dispatch_ema_s >= 0.05
+        assert 0.005 < v._effective_delay_s() <= 0.5 * v._dispatch_ema_s + 0.005
+
+    asyncio.run(main())
