@@ -158,8 +158,11 @@ class BatchedSignatureVerifier(BlockVerifier):
 
     Consensus wants low verification turnaround; the TPU wants large batches.
     Policy: a block's verification completes when either (a) ``max_batch``
-    items have accumulated, or (b) ``max_delay_s`` elapsed since the first
-    pending item — whichever comes first (SURVEY §7 hard part #2).
+    items have accumulated, or (b) the collection window elapsed since the
+    first pending item — whichever comes first (SURVEY §7 hard part #2).
+    The window is ``max_delay_s`` on a co-located device and widens to 20%
+    of the observed dispatch latency (capped at ``MAX_ADAPTIVE_DELAY_S``)
+    when the accelerator is remote — see ``_effective_delay_s``.
 
     Usable from any number of asyncio tasks (one per peer connection); the
     device dispatch runs in a worker thread so the event loop never blocks on
@@ -186,12 +189,25 @@ class BatchedSignatureVerifier(BlockVerifier):
         # (tunneled/remote chip, ~100 ms+ per dispatch), a 5 ms collection
         # window dispatches tiny batches back-to-back and the queue of
         # round-trips becomes the latency — waiting a fraction of the
-        # measured RTT instead coalesces them at a bounded (~20%) cost on a
-        # latency already dominated by that RTT.
+        # measured latency instead coalesces them at a bounded cost on a
+        # latency already dominated by the round-trip.  The window is clamped
+        # to MAX_ADAPTIVE_DELAY_S (a compile stall or compute-heavy batch
+        # must never push consensus turnaround past ~0.1 s), and dispatches
+        # slower than EMA_OUTLIER_S (one-time JAX compiles) are not fed into
+        # the EMA at all.
         self._dispatch_ema_s = 0.0
 
+    MAX_ADAPTIVE_DELAY_S = 0.1
+    EMA_OUTLIER_S = 5.0
+
     def _effective_delay_s(self) -> float:
-        return max(self.max_delay_s, 0.2 * self._dispatch_ema_s)
+        """Collection window: max_delay_s is the floor, 20% of the dispatch-
+        latency EMA widens it for remote devices, MAX_ADAPTIVE_DELAY_S caps
+        it."""
+        return min(
+            max(self.max_delay_s, 0.2 * self._dispatch_ema_s),
+            max(self.max_delay_s, self.MAX_ADAPTIVE_DELAY_S),
+        )
 
     async def verify(self, block: StatementBlock) -> None:
         loop = asyncio.get_running_loop()
@@ -234,11 +250,12 @@ class BatchedSignatureVerifier(BlockVerifier):
                 None, self.verifier.verify_signatures, pks, digests, sigs
             )
             elapsed = time.monotonic() - started
-            self._dispatch_ema_s = (
-                elapsed
-                if self._dispatch_ema_s == 0.0
-                else 0.8 * self._dispatch_ema_s + 0.2 * elapsed
-            )
+            if elapsed < self.EMA_OUTLIER_S:  # ignore one-time compile stalls
+                self._dispatch_ema_s = (
+                    elapsed
+                    if self._dispatch_ema_s == 0.0
+                    else 0.8 * self._dispatch_ema_s + 0.2 * elapsed
+                )
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting
             # connection tasks forever — fail every future in the batch.

@@ -170,6 +170,11 @@ def test_adaptive_batching_window_tracks_dispatch_latency():
         await v.verify(blk)
         await v.flush_now()
         assert v._dispatch_ema_s >= 0.05
-        assert 0.005 < v._effective_delay_s() <= 0.5 * v._dispatch_ema_s + 0.005
+        assert v._effective_delay_s() == pytest.approx(0.2 * v._dispatch_ema_s)
+        # the window is capped: a compile stall cannot push it past the max
+        v._dispatch_ema_s = 30.0
+        assert v._effective_delay_s() == v.MAX_ADAPTIVE_DELAY_S
+        # and outlier dispatches never enter the EMA
+        assert v.EMA_OUTLIER_S < 30.0
 
     asyncio.run(main())
