@@ -740,7 +740,10 @@ def fetch_handles(handles) -> np.ndarray:
         return np.zeros(0, bool)
     if len(handles) == 1:
         count, h = handles[0]
-        return np.asarray(h)[:count]
+        # np.array (not asarray): a writable copy, matching the multi-chunk
+        # path — callers patch straggler entries in place.  The copy is a
+        # bool row per signature, noise next to the transfer itself.
+        return np.array(np.asarray(h)[:count])
     flat = np.asarray(jnp.concatenate([h for _, h in handles]))
     out = np.empty(sum(count for count, _ in handles), bool)
     src = dst = 0

@@ -188,22 +188,22 @@ def sharded_verify_batch_indexed(
     known = idx >= 0
     kernel = _cached_indexed_kernel(mesh)
     blob = E.pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
+    # The psum'd per-chunk total is compiled and executed (the ICI collective
+    # is part of the sharded program) but not fetched: padded lanes are
+    # host_ok=False, so the global count equals the host-side sum of the
+    # combined single fetch — one round-trip instead of 2 per chunk.
     handles = [
         (
-            start,
             count,
             kernel(
                 jnp.asarray(E._pad_to(blob[start : start + count], b)),
                 table.words,
-            ),
+            )[0],
         )
         for start, count, b in E.iter_buckets(n)
     ]
-    out = np.empty(n, bool)
-    total = 0
-    for start, count, (ok, tot) in handles:
-        out[start : start + count] = np.asarray(ok)[:count]
-        total += int(tot)
+    out = E.fetch_handles(handles)
+    total = int(out.sum())
     if not known.all():
         stragglers = np.flatnonzero(~known)
         ok_s, _ = sharded_verify_batch_fused(
@@ -236,22 +236,19 @@ def sharded_verify_batch_fused(
     kernel = _cached_fused_kernel(mesh)
     msg_words, s_words, host_ok = E.pack_bytes(public_keys, messages, signatures)
     # Dispatch every chunk asynchronously, force once at the end — same
-    # overlap policy as ops.ed25519.dispatch_blob_chunks.
+    # overlap policy as ops.ed25519.dispatch_blob_chunks.  The psum total is
+    # compiled (the ICI collective stays in the program) but recomputed from
+    # the combined fetch: padded lanes are host_ok=False, so the sums agree.
     handles = [
         (
-            start,
             count,
             kernel(
                 jnp.asarray(E._pad_to(msg_words[start : start + count], b)),
                 jnp.asarray(E._pad_to(s_words[start : start + count], b)),
                 jnp.asarray(E._pad_to(host_ok[start : start + count], b)),
-            ),
+            )[0],
         )
         for start, count, b in E.iter_buckets(n)
     ]
-    out = np.empty(n, bool)
-    total = 0
-    for start, count, (ok, tot) in handles:
-        out[start : start + count] = np.asarray(ok)[:count]
-        total += int(tot)
-    return out, total
+    out = E.fetch_handles(handles)
+    return out, int(out.sum())
