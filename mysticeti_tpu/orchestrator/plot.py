@@ -38,6 +38,8 @@ def plot_latency_throughput(
     for points in series.values():
         points.sort()
 
+    parent = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(parent, exist_ok=True)
     written = []
     txt_path = out_path + ".txt"
     with open(txt_path, "w") as f:
