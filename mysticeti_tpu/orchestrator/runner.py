@@ -164,12 +164,13 @@ class LocalProcessRunner(Runner):
 
 
 class SshRunner(Runner):
-    """Remote fleet through the system ssh binary (ssh.rs re-imagined).
+    """Remote fleet over :class:`~.ssh.SshManager` (ssh.rs re-imagined):
+    retried/timed-out remote execution, scp config upload, background node
+    sessions with pidfiles.
 
     ``hosts``: one reachable address per validator.  Assumes the repo is
-    deployed at ``remote_repo`` on every host (the reference's install/update
-    steps, orchestrator.rs:281-475, are a deployment concern left to the
-    operator or a one-line ``git clone`` per host).
+    deployed at ``remote_repo`` on every host (``fleet install``/``update``
+    handle that, or a one-line ``git clone`` per host).
     """
 
     def __init__(
@@ -181,27 +182,21 @@ class SshRunner(Runner):
         tps_per_node: int = 100,
         verifier: str = "tpu",
         ssh_args: Optional[List[str]] = None,
+        ssh: Optional["SshManager"] = None,
     ) -> None:
+        from .ssh import SshManager
+
         self.hosts = hosts
         self.remote_repo = remote_repo
         self.working_dir = working_dir
         self.python = python
         self.tps_per_node = tps_per_node
         self.verifier = verifier
-        self.ssh_args = ssh_args or ["-o", "StrictHostKeyChecking=no"]
+        self.ssh = ssh or SshManager(hosts, ssh_args=ssh_args)
         self.parameters: Optional[Parameters] = None
 
-    async def _ssh(self, host: str, command: str) -> Tuple[int, bytes]:
-        proc = await asyncio.create_subprocess_exec(
-            "ssh",
-            *self.ssh_args,
-            host,
-            command,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.STDOUT,
-        )
-        out, _ = await proc.communicate()
-        return proc.returncode or 0, out
+    def _session(self, authority: int) -> str:
+        return f"mysticeti-node-{authority}"
 
     async def configure(self, committee_size: int, load_tx_s: int = 0) -> None:
         assert committee_size <= len(self.hosts)
@@ -213,39 +208,54 @@ class SshRunner(Runner):
         benchmark_genesis(self.hosts[:committee_size], local)
         self.parameters = Parameters.load(os.path.join(local, "parameters.yaml"))
         for i, host in enumerate(self.hosts[:committee_size]):
-            await self._ssh(host, f"mkdir -p {self.working_dir}")
-            proc = await asyncio.create_subprocess_exec(
-                "scp",
-                *self.ssh_args,
-                "-r",
-                os.path.join(local, "committee.yaml"),
-                os.path.join(local, "parameters.yaml"),
-                os.path.join(local, f"validator-{i}"),
-                f"{host}:{self.working_dir}/",
+            await self.ssh.execute(host, f"rm -rf {self.working_dir}/validator-{i}")
+            await self.ssh.upload(
+                host,
+                [
+                    os.path.join(local, "committee.yaml"),
+                    os.path.join(local, "parameters.yaml"),
+                    os.path.join(local, f"validator-{i}"),
+                ],
+                self.working_dir,
             )
-            await proc.wait()
 
     async def boot_node(self, authority: int) -> None:
+        from .ssh import CommandContext
+
         host = self.hosts[authority]
-        cmd = (
-            f"cd {self.remote_repo} && TPS={self.tps_per_node} nohup {self.python} -m"
-            f" mysticeti_tpu run --authority {authority}"
+        context = CommandContext(
+            path=self.remote_repo,
+            env={"TPS": str(self.tps_per_node)},
+            background=self._session(authority),
+            log_file=f"{self.working_dir}/node-{authority}.log",
+        )
+        await self.ssh.execute(
+            host,
+            f"{self.python} -m mysticeti_tpu run --authority {authority}"
             f" --committee-path {self.working_dir}/committee.yaml"
             f" --parameters-path {self.working_dir}/parameters.yaml"
             f" --private-config-path {self.working_dir}/validator-{authority}"
-            f" --verifier {self.verifier}"
-            f" > {self.working_dir}/node.log 2>&1 & echo started"
+            f" --verifier {self.verifier}",
+            context,
         )
-        await self._ssh(host, cmd)
 
     async def kill_node(self, authority: int) -> None:
-        await self._ssh(
-            self.hosts[authority], "pkill -f 'mysticeti_tpu run' || true"
-        )
+        await self.ssh.kill_session(self.hosts[authority], self._session(authority))
 
     async def scrape(self, authority: int) -> Optional[str]:
         host, port = self.parameters.metrics_address(authority)
         return await _http_get_metrics(self.hosts[authority].split("@")[-1], port)
+
+    async def download_logs(self, dest_dir: str) -> List[str]:
+        """Pull every node's log (orchestrator.rs log-download step)."""
+        paths = []
+        for i, host in enumerate(self.hosts):
+            local = os.path.join(dest_dir, f"node-{i}.log")
+            await self.ssh.download(
+                host, f"{self.working_dir}/node-{i}.log", local
+            )
+            paths.append(local)
+        return paths
 
     async def cleanup(self) -> None:
         for i in range(len(self.hosts)):

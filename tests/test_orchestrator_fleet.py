@@ -192,3 +192,44 @@ def test_flamegraph_svg_renders(tmp_path):
     src.write_text("\n".join(folded) + "\n")
     out = render_file(str(src))
     assert out.endswith(".svg") and os.path.exists(out)
+
+
+def test_ssh_runner_composes_fleet_commands(tmp_path):
+    """SshRunner rides SshManager: genesis upload, background boot under a
+    pidfile session, kill-session teardown (orchestrator.rs:215-475 shape)."""
+    import asyncio
+
+    from mysticeti_tpu.orchestrator.runner import SshRunner
+
+    class RecordingManager(SshManager):
+        def __init__(self, hosts):
+            super().__init__(hosts, retry_delay_s=0.0)
+            self.commands = []  # (host, command)
+
+        async def _spawn(self, argv, timeout_s):
+            self.commands.append(argv)
+            return 0, b"ok"
+
+    hosts = ["u@h0", "u@h1", "u@h2", "u@h3"]
+    mgr = RecordingManager(hosts)
+    runner = SshRunner(hosts, remote_repo="/opt/mysticeti", ssh=mgr)
+
+    async def main():
+        await runner.configure(4, load_tx_s=200)
+        await runner.boot_node(2)
+        await runner.kill_node(2)
+        await runner.cleanup()
+
+    asyncio.run(main())
+    flat = [" ".join(argv) for argv in mgr.commands]
+    # upload happened per host (scp) after a mkdir
+    assert sum("scp" == argv[0] for argv in mgr.commands) == 4
+    assert any("mkdir -p" in c for c in flat)
+    # boot: background session with pidfile, cd into the checkout, TPS env
+    boot = [c for c in flat if "mysticeti_tpu run" in c and "--authority 2" in c]
+    assert boot and "setsid nohup" in boot[0] and "TPS=50" in boot[0]
+    assert "mysticeti-node-2.pid" in boot[0]
+    assert "cd /opt/mysticeti" in boot[0]
+    # teardown kills the session pidfile for every node
+    kills = [c for c in flat if ".pid" in c and "kill" in c]
+    assert len(kills) >= 5  # node 2 once + cleanup x4
