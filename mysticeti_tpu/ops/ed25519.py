@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-from functools import partial
 from typing import Optional, Sequence, Tuple
 
 import numpy as np

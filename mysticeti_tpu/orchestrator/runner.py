@@ -17,7 +17,7 @@ import asyncio
 import os
 import signal
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from ..cli import benchmark_genesis
 from ..config import Parameters

@@ -11,14 +11,13 @@ Tested on a virtual 8-device CPU mesh (``--xla_force_host_platform_device_count`
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+from jax.sharding import Mesh, PartitionSpec as PSpec
 
 try:
     from jax import shard_map as _shard_map  # jax >= 0.8
