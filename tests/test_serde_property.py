@@ -92,6 +92,7 @@ def test_corrupted_frames_never_misdecode(data):
             decoded.verify(committee)
 
 
+@pytest.mark.parametrize("use_native", [True, False])
 @given(
     entries=st.lists(
         st.tuples(st.integers(1, 200), st.binary(max_size=300)), max_size=12
@@ -99,12 +100,21 @@ def test_corrupted_frames_never_misdecode(data):
     cut_fraction=st.floats(0.0, 1.0),
 )
 @settings(max_examples=100, deadline=None)
-def test_wal_replay_prefix_under_truncation(tmp_path_factory, entries, cut_fraction):
+def test_wal_replay_prefix_under_truncation(
+    tmp_path_factory, use_native, entries, cut_fraction
+):
     """Crash-recovery contract (wal.rs:270-293): after truncating the file at
     ANY byte, replay yields exactly the entries wholly before the cut —
     everything durable is recovered, the torn tail is dropped, nothing
     mis-frames."""
+    import mysticeti_tpu.wal as wal_mod
     from mysticeti_tpu.wal import HEADER_SIZE, walf
+
+    saved_native = wal_mod._native
+    if not use_native:
+        wal_mod._native = None  # pure-Python fallback path
+    elif saved_native is None:
+        pytest.skip("native extension unavailable")
 
     tmp = tmp_path_factory.mktemp("walprop")
     path = str(tmp / "wal")
@@ -121,7 +131,10 @@ def test_wal_replay_prefix_under_truncation(tmp_path_factory, entries, cut_fract
     with open(path, "r+b") as f:
         f.truncate(cut)
 
-    replayed = list(reader.iter_until())
+    try:
+        replayed = list(reader.iter_until())
+    finally:
+        wal_mod._native = saved_native
     # Truncation can only damage the tail: every entry that fits wholly
     # before the cut MUST be recovered verbatim, and nothing after it may
     # mis-frame into a phantom entry.
