@@ -233,3 +233,26 @@ def test_ssh_runner_composes_fleet_commands(tmp_path):
     # teardown kills the session pidfile for every node
     kills = [c for c in flat if ".pid" in c and "kill" in c]
     assert len(kills) >= 5  # node 2 once + cleanup x4
+
+
+def test_settings_make_ssh_runner_and_testbed_logs(tmp_path):
+    """Settings -> SshRunner construction and Testbed.download_logs over the
+    fake transport (the `fleet logs` CLI path)."""
+    from mysticeti_tpu.orchestrator.settings import Settings
+
+    s = Settings(runner="ssh", hosts=["u@h0", "u@h1"], remote_repo="/opt/m")
+    runner = s.make_runner()
+    from mysticeti_tpu.orchestrator.runner import SshRunner
+
+    assert isinstance(runner, SshRunner) and runner.remote_repo == "/opt/m"
+
+    provider = StaticProvider(["u@h0", "u@h1"], str(tmp_path / "s.json"))
+    run(provider.create_instances(2, "local"))
+    ssh = FlakyTransport(["u@h0", "u@h1"])
+    tb = Testbed(provider, ssh=ssh)
+    dest = str(tmp_path / "logs")
+    paths = run(tb.download_logs("/tmp/mysticeti-bench", dest))
+    assert len(paths) == 2
+    scps = [argv for argv in ssh.calls if argv[0] == "scp"]
+    assert len(scps) == 2
+    assert any("u@h0:/tmp/mysticeti-bench" in " ".join(a) for a in scps)
