@@ -203,10 +203,10 @@ class BatchedSignatureVerifier(BlockVerifier):
     def _effective_delay_s(self) -> float:
         """Collection window: max_delay_s is the floor, 20% of the dispatch-
         latency EMA widens it for remote devices, MAX_ADAPTIVE_DELAY_S caps
-        it."""
-        return min(
-            max(self.max_delay_s, 0.2 * self._dispatch_ema_s),
-            max(self.max_delay_s, self.MAX_ADAPTIVE_DELAY_S),
+        the widening."""
+        return max(
+            self.max_delay_s,
+            min(0.2 * self._dispatch_ema_s, self.MAX_ADAPTIVE_DELAY_S),
         )
 
     async def verify(self, block: StatementBlock) -> None:

@@ -575,21 +575,18 @@ def verify_batch_table(
         return verify_batch(public_keys, messages, signatures)
     idx = table.indices_for(public_keys)
     known = idx >= 0
-    out = np.zeros(n, bool)
-    if known.all():
-        blob = pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
-        return fetch_handles(dispatch_indexed_chunks(blob, table))
     blob = pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
     handles = dispatch_indexed_chunks(blob, table)
-    stragglers = [i for i in range(n) if not known[i]]
+    if known.all():
+        return fetch_handles(handles)
+    stragglers = np.flatnonzero(~known)
     generic = verify_batch(
         [public_keys[i] for i in stragglers],
         [messages[i] for i in stragglers],
         [signatures[i] for i in stragglers],
     )
-    out[:] = fetch_handles(handles)
-    for j, i in enumerate(stragglers):
-        out[i] = generic[j]
+    out = fetch_handles(handles)
+    out[stragglers] = generic
     return out
 
 
