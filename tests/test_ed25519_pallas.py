@@ -91,3 +91,21 @@ def test_pallas_rejects_bad_tile():
     packed = E.pack_batch(pks, msgs, sigs)
     with pytest.raises(ValueError):
         EP.verify_pallas(*packed, tile=8, interpret=True)
+
+
+def test_pallas_indexed_blob_matches_oracle():
+    """The committee-indexed Pallas entry (verify_fused_indexed_blob_pallas)
+    in interpret mode: the TPU-only wire format must agree with the
+    expected accept/reject pattern and the XLA indexed kernel."""
+    pks, msgs, sigs, expect = _batch(16, seed=5)
+    table = E.KeyTable(sorted(set(pks)))
+    idx = table.indices_for(pks)
+    blob = E.pack_blob_indexed(idx, msgs, sigs, num_keys=len(table))
+    got = np.asarray(
+        EP.verify_fused_indexed_blob_pallas(
+            blob, table.words, tile=8, interpret=True
+        )
+    )
+    assert (got == expect).all()
+    xla = np.asarray(E.verify_fused_indexed_kernel(blob, table.words))
+    assert (got == xla).all()
