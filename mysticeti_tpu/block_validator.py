@@ -153,6 +153,115 @@ class TpuSignatureVerifier(SignatureVerifier):
         return list(ed25519.verify_batch(public_keys, digests, signatures))
 
 
+def _update_ema(current: float, sample: float, outlier_s: float) -> float:
+    """EMA with outlier rejection, shared by the batching collector's window
+    and the hybrid router's calibration: samples past ``outlier_s`` (one-time
+    JAX compiles) never enter; the first sample seeds."""
+    if sample >= outlier_s:
+        return current
+    return sample if current == 0.0 else 0.8 * current + 0.2 * sample
+
+
+class HybridSignatureVerifier(SignatureVerifier):
+    """Route small batches to the CPU oracle, large ones to the TPU kernel
+    (SURVEY §7 hard part #2: "CPU fallback for stragglers").
+
+    A TPU dispatch pays a fixed round-trip (µs co-located, ~100 ms over a
+    tunnel) regardless of batch size, so below some batch size the serial CPU
+    verify finishes before the accelerator round-trip would.  Both sides of
+    the crossover are *measured*, not assumed:
+
+    * ``cpu_per_sig_s`` — EMA of per-signature CPU cost, seeded by a warmup
+      calibration over real signatures, updated on every CPU-routed dispatch;
+    * ``tpu_dispatch_s`` — EMA of whole-dispatch TPU latency, seeded by a
+      post-compile probe dispatch, updated on every TPU-routed dispatch.
+
+    The routing threshold is ``tpu_dispatch_s / cpu_per_sig_s`` (the batch
+    size at which CPU time equals one accelerator round-trip), additionally
+    capped so a CPU-routed batch never occupies the host for more than
+    ``MAX_CPU_BUDGET_S``: on a box where the engine shares the core with the
+    verifier, winning the latency race by stealing the core from consensus
+    is a false economy (a 100 ms tunnel RTT would otherwise push the
+    crossover past the collector's own max_batch and starve the TPU path
+    entirely at saturation).
+    """
+
+    DEFAULT_THRESHOLD = 32  # until both EMAs are seeded
+    MAX_CPU_BUDGET_S = 0.010  # max host time one CPU-routed batch may take
+    EMA_OUTLIER_S = 5.0  # ignore one-time compile stalls
+
+    def __init__(
+        self,
+        tpu: Optional[SignatureVerifier] = None,
+        cpu: Optional[SignatureVerifier] = None,
+        threshold: Optional[int] = None,
+    ) -> None:
+        self.tpu = tpu or TpuSignatureVerifier()
+        self.cpu = cpu or CpuSignatureVerifier()
+        self._fixed_threshold = threshold
+        self.cpu_per_sig_s = 0.0
+        self.tpu_dispatch_s = 0.0
+        # Set after every dispatch; the batching collector reports it as the
+        # metrics backend label so the cpu/tpu split is observable.
+        self.backend_label = "hybrid"
+
+    def threshold(self) -> int:
+        if self._fixed_threshold is not None:
+            return self._fixed_threshold
+        if not (self.cpu_per_sig_s > 0.0 and self.tpu_dispatch_s > 0.0):
+            return self.DEFAULT_THRESHOLD
+        crossover = self.tpu_dispatch_s / self.cpu_per_sig_s
+        budget_cap = self.MAX_CPU_BUDGET_S / self.cpu_per_sig_s
+        return max(1, int(min(crossover, budget_cap)))
+
+    def warmup(self) -> None:
+        from . import crypto
+
+        self.tpu.warmup()  # trace/compile (or persistent-cache load)
+        # Probe dispatch AFTER the compile: measures the steady-state
+        # accelerator round-trip, not the one-time trace.
+        signer = crypto.Signer.dummy()
+        digest = crypto.blake2b_256(b"hybrid-warmup")
+        sig = signer.sign(digest)
+        pk = signer.public_key.bytes
+        started = time.monotonic()
+        self.tpu.verify_signatures([pk], [digest], [sig])
+        self.tpu_dispatch_s = time.monotonic() - started
+        started = time.monotonic()
+        reps = 32
+        self.cpu.verify_signatures([pk] * reps, [digest] * reps, [sig] * reps)
+        self.cpu_per_sig_s = (time.monotonic() - started) / reps
+        log.info(
+            "hybrid verifier calibrated: tpu dispatch %.1f ms, cpu %.0f µs/sig"
+            " -> threshold %d",
+            1e3 * self.tpu_dispatch_s,
+            1e6 * self.cpu_per_sig_s,
+            self.threshold(),
+        )
+
+    def verify_signatures(self, public_keys, digests, signatures):
+        n = len(signatures)
+        if n == 0:
+            return []
+        if n < self.threshold():
+            started = time.monotonic()
+            out = self.cpu.verify_signatures(public_keys, digests, signatures)
+            self.cpu_per_sig_s = _update_ema(
+                self.cpu_per_sig_s,
+                (time.monotonic() - started) / n,
+                self.EMA_OUTLIER_S,
+            )
+            self.backend_label = "hybrid-cpu"
+            return out
+        started = time.monotonic()
+        out = self.tpu.verify_signatures(public_keys, digests, signatures)
+        self.tpu_dispatch_s = _update_ema(
+            self.tpu_dispatch_s, time.monotonic() - started, self.EMA_OUTLIER_S
+        )
+        self.backend_label = "hybrid-tpu"
+        return out
+
+
 class BatchedSignatureVerifier(BlockVerifier):
     """Deadline/size-triggered batching collector in front of a SignatureVerifier.
 
@@ -245,17 +354,23 @@ class BatchedSignatureVerifier(BlockVerifier):
         sigs = [b.signature for b in blocks]
         loop = asyncio.get_running_loop()
         started = time.monotonic()
-        try:
-            results = await loop.run_in_executor(
-                None, self.verifier.verify_signatures, pks, digests, sigs
+
+        def _dispatch():
+            # The backend label must be captured in the same thread as the
+            # dispatch: reading it after the await would race with concurrent
+            # flushes that routed the other way (hybrid cpu/tpu split).
+            out = self.verifier.verify_signatures(pks, digests, sigs)
+            label = getattr(
+                self.verifier, "backend_label", type(self.verifier).__name__
             )
+            return out, label
+
+        try:
+            results, backend = await loop.run_in_executor(None, _dispatch)
             elapsed = time.monotonic() - started
-            if elapsed < self.EMA_OUTLIER_S:  # ignore one-time compile stalls
-                self._dispatch_ema_s = (
-                    elapsed
-                    if self._dispatch_ema_s == 0.0
-                    else 0.8 * self._dispatch_ema_s + 0.2 * elapsed
-                )
+            self._dispatch_ema_s = _update_ema(
+                self._dispatch_ema_s, elapsed, self.EMA_OUTLIER_S
+            )
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting
             # connection tasks forever — fail every future in the batch.
@@ -269,7 +384,6 @@ class BatchedSignatureVerifier(BlockVerifier):
             return
         if self.metrics is not None:
             self.metrics.verify_batch_size.observe(len(batch))
-            backend = type(self.verifier).__name__
             accepted = sum(bool(ok) for ok in results)
             self.metrics.verified_signatures_total.labels(backend, "accepted").inc(
                 accepted

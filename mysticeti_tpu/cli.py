@@ -9,8 +9,11 @@ Capability parity with ``mysticeti/src/main.rs``:
   in-process benchmark config for N authorities and runs one of them.
 * ``testbed`` (:68-73,187-227) — N in-process validators on localhost.
 
-Plus this framework's switch: ``--verifier {accept,cpu,tpu}`` selects the
-signature backend (TPU = the batched JAX kernel).
+Plus this framework's switch: ``--verifier {accept,cpu,tpu,tpu-only}``
+selects the signature backend: ``tpu`` is the hybrid policy (batched JAX
+kernel for large batches, CPU oracle for small ones — SURVEY §7 hard part
+#2), ``tpu-only`` pins every batch to the kernel (saturation benchmarks),
+``cpu`` is the serial OpenSSL oracle (reference behavior).
 """
 from __future__ import annotations
 
@@ -136,19 +139,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     r.add_argument("--committee-path", required=True)
     r.add_argument("--parameters-path", required=True)
     r.add_argument("--private-config-path", required=True)
-    r.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
+    r.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
 
     d = sub.add_parser("dry-run", help="one validator of an N-node local setup")
     d.add_argument("--committee-size", type=int, required=True)
     d.add_argument("--authority", type=int, required=True)
     d.add_argument("--working-directory", default="dryrun")
-    d.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
+    d.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
 
     t = sub.add_parser("testbed", help="N in-process validators")
     t.add_argument("--committee-size", type=int, required=True)
     t.add_argument("--working-directory", default="testbed")
     t.add_argument("--duration", type=float, default=30.0)
-    t.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
+    t.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
 
     o = sub.add_parser(
         "orchestrator",
@@ -168,7 +171,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     o.add_argument("--fault-kind", choices=["none", "permanent", "crash-recovery"],
                    default="none")
     o.add_argument("--fault-interval", type=float, default=30.0)
-    o.add_argument("--verifier", choices=["accept", "cpu", "tpu"], default="cpu")
+    o.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
     o.add_argument("--tps-per-node", type=int, default=None,
                    help="override the generator load split (default: load/nodes)")
     o.add_argument("--working-directory", default="benchmark-fleet")

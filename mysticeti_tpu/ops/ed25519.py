@@ -44,8 +44,29 @@ if jax.config.jax_compilation_cache_dir is None:
         # or attacker-pre-created dir would let another local user plant
         # crafted cache entries (deserialized executables).  /tmp's sticky bit
         # protects only the top level, so the uid suffix alone is not enough.
+        #
+        # The path is also keyed on the host's CPU microarchitecture: XLA:CPU
+        # AOT executables are compiled for the build machine's features, and a
+        # cache dir shared across heterogeneous machines makes every load
+        # attempt log a cpu_aot_loader machine-mismatch error ("could lead to
+        # SIGILL") before recompiling.  A per-machine key turns that into a
+        # silent cache miss.
+        import hashlib
+        import platform as _platform
+
+        _feat = _platform.machine()
+        try:
+            with open("/proc/cpuinfo") as _f:
+                for _line in _f:
+                    if _line.startswith(("flags", "Features")):
+                        _feat += _line
+                        break
+        except OSError:
+            pass
+        _mkey = hashlib.blake2b(_feat.encode(), digest_size=4).hexdigest()
         _cache = os.path.join(
-            tempfile.gettempdir(), f"mysticeti-tpu-jax-cache-{os.getuid()}"
+            tempfile.gettempdir(),
+            f"mysticeti-tpu-jax-cache-{os.getuid()}-{_mkey}",
         )
         try:
             os.makedirs(_cache, mode=0o700, exist_ok=True)

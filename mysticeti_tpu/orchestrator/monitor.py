@@ -13,6 +13,7 @@ import json
 import os
 import shutil
 import subprocess
+import time
 from typing import List, Optional, Tuple
 
 PROMETHEUS_PORT = 9090
@@ -103,9 +104,12 @@ def grafana_provisioning(out_dir: str) -> None:
 class MonitoringStack:
     """Generate the monitoring tree; start prometheus when available."""
 
+    GRAFANA_STARTUP_GRACE_S = 0.5
+
     def __init__(self, out_dir: str) -> None:
         self.out_dir = out_dir
         self.prometheus_proc: Optional[subprocess.Popen] = None
+        self.grafana_proc: Optional[subprocess.Popen] = None
 
     def deploy(self, metric_targets: List[str]) -> str:
         os.makedirs(self.out_dir, exist_ok=True)
@@ -133,13 +137,68 @@ class MonitoringStack:
         )
         return True
 
+    def start_grafana(self) -> bool:
+        """Launch a local grafana against the generated provisioning tree when
+        the binary exists (monitor.rs:86-104 ``start_grafana`` parity); returns
+        False (config-only mode) otherwise.
+
+        The reference runs the official container with the provisioning dir
+        mounted; here the same tree is handed over through grafana's
+        ``GF_PATHS_PROVISIONING`` environment override, and the dashboard
+        provider path is rewritten to the generated ``grafana/dashboards``
+        directory rather than the container's ``/etc/grafana/dashboards``.
+        """
+        binary = shutil.which("grafana-server") or shutil.which("grafana")
+        if binary is None:
+            return False
+        grafana_dir = os.path.join(self.out_dir, "grafana")
+        provider = os.path.join(
+            grafana_dir, "provisioning", "dashboards", "provider.yaml")
+        if os.path.exists(provider):
+            text = open(provider).read().replace(
+                "/etc/grafana/dashboards", os.path.join(grafana_dir, "dashboards"))
+            with open(provider, "w") as f:
+                f.write(text)
+        env = dict(os.environ)
+        env.update({
+            "GF_PATHS_PROVISIONING": os.path.join(grafana_dir, "provisioning"),
+            "GF_PATHS_DATA": os.path.join(grafana_dir, "data"),
+            "GF_PATHS_LOGS": os.path.join(grafana_dir, "logs"),
+            "GF_SERVER_HTTP_PORT": str(GRAFANA_PORT),
+            "GF_AUTH_ANONYMOUS_ENABLED": "true",
+        })
+        # Grafana refuses to start without its homepath (conf/defaults.ini);
+        # point it at the conventional install location when present.
+        for home in ("/usr/share/grafana", "/opt/grafana"):
+            if os.path.isdir(home):
+                env["GF_PATHS_HOME"] = home
+                break
+        args = [binary] if binary.endswith("grafana-server") else [binary, "server"]
+        self.grafana_proc = subprocess.Popen(
+            args,
+            env=env,
+            cwd=env.get("GF_PATHS_HOME", grafana_dir),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        # Liveness check: a misconfigured grafana exits within a moment, and
+        # with stderr discarded a bare `return True` would report dashboards
+        # up at :3000 with nothing listening.
+        time.sleep(self.GRAFANA_STARTUP_GRACE_S)
+        if self.grafana_proc.poll() is not None:
+            self.grafana_proc = None
+            return False
+        return True
+
     def stop(self) -> None:
-        proc, self.prometheus_proc = self.prometheus_proc, None
-        if proc is None:
-            return
-        proc.terminate()
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
+        for attr in ("prometheus_proc", "grafana_proc"):
+            proc = getattr(self, attr)
+            setattr(self, attr, None)
+            if proc is None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()

@@ -54,9 +54,20 @@ class CommandContext:
         if self.background is not None:
             log = self.log_file or "/dev/null"
             inner = " ".join(parts + [command])
-            return (
+            pidfile = self.pidfile()
+            # Idempotent spawn: SshManager.execute retries on transient
+            # failures, and a dropped connection after the remote process
+            # launched would otherwise double-spawn it (and the pidfile would
+            # only remember the last pid, orphaning the first).  Guard on a
+            # live pidfile the way the reference's `tmux new -s <id>` fails
+            # fast on a duplicate session name (ssh.rs:83).
+            spawn = (
                 f"setsid nohup sh -c {shlex.quote(inner)} > {log} 2>&1 &"
-                f" echo $! > {self.pidfile()}"
+                f" echo $! > {pidfile}"
+            )
+            return (
+                f"if [ -f {pidfile} ] && kill -0 -- -$(cat {pidfile})"
+                f" 2>/dev/null; then true; else {spawn}; fi"
             )
         return " ".join(parts + [command])
 

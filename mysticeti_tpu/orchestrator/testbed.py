@@ -77,6 +77,13 @@ class StaticProvider(ServerProvider):
                 for raw in json.load(f):
                     inst = Instance(**raw)
                     self._instances[inst.id] = inst
+        # Monotonic id source: never reuse a live instance's id after a
+        # terminate+create cycle (ids are `i-NNNN`; start past the highest
+        # ever persisted).
+        self._next_id = 1 + max(
+            (int(i.id.rsplit("-", 1)[1]) for i in self._instances.values()),
+            default=-1,
+        )
 
     def _save(self) -> None:
         if self.state_path:
@@ -100,8 +107,9 @@ class StaticProvider(ServerProvider):
             )
         created = []
         for host in free[:count]:
-            inst = Instance(id=f"i-{len(self._instances):04d}", host=host,
+            inst = Instance(id=f"i-{self._next_id:04d}", host=host,
                             region=region, active=True)
+            self._next_id += 1
             self._instances[inst.id] = inst
             created.append(inst)
         self._save()

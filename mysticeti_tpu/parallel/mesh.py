@@ -38,9 +38,16 @@ def shard_map(f, **kwargs):
 from ..ops import ed25519 as E
 
 
-def make_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """A 1-D mesh over the first ``n_devices`` local devices (axis: ``batch``)."""
-    devices = jax.devices()
+def make_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices (axis: ``batch``).
+
+    ``devices`` overrides the default-backend device list — e.g.
+    ``jax.devices("cpu")`` to build a virtual host mesh in a process whose
+    default backend is already pinned to the TPU.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), axis_names=("batch",))

@@ -28,6 +28,7 @@ from .block_validator import (
     AcceptAllBlockVerifier,
     BatchedSignatureVerifier,
     CpuSignatureVerifier,
+    HybridSignatureVerifier,
     TpuSignatureVerifier,
 )
 from .commit_observer import SimpleCommitObserver, TestCommitObserver
@@ -67,12 +68,21 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
     import threading
 
     ready = threading.Event()
-    if kind == "tpu":
-        backend = TpuSignatureVerifier(
+    if kind in ("tpu", "tpu-only"):
+        tpu_backend = TpuSignatureVerifier(
             committee_keys=[
                 committee.get_public_key(a).bytes
                 for a in range(len(committee))
             ]
+        )
+        # "tpu" deploys the hybrid dispatch policy (small batches take the
+        # CPU oracle, sparing them the accelerator round-trip — SURVEY §7
+        # hard part #2); "tpu-only" pins every batch to the kernel, which is
+        # what a saturation benchmark wants to measure.
+        backend = (
+            tpu_backend
+            if kind == "tpu-only"
+            else HybridSignatureVerifier(tpu=tpu_backend)
         )
 
         def _warm() -> None:

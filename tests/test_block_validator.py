@@ -139,6 +139,81 @@ def test_validators_with_cpu_signature_verification(tmp_path):
     asyncio.run(main())
 
 
+def test_hybrid_verifier_routes_by_batch_size():
+    """Small batches take the CPU oracle, large ones the TPU backend; the
+    threshold is the measured crossover, capped by the CPU time budget."""
+    from mysticeti_tpu.block_validator import (
+        HybridSignatureVerifier,
+        SignatureVerifier,
+    )
+
+    class Recorder(SignatureVerifier):
+        def __init__(self):
+            self.calls = []
+
+        def verify_signatures(self, pks, digests, sigs):
+            self.calls.append(len(sigs))
+            return [True] * len(sigs)
+
+    tpu, cpu = Recorder(), Recorder()
+    hybrid = HybridSignatureVerifier(tpu=tpu, cpu=cpu)
+    # Pretend calibration: 100 ms accelerator round-trip, 100 µs/sig CPU.
+    hybrid.tpu_dispatch_s = 0.100
+    hybrid.cpu_per_sig_s = 100e-6
+    # Crossover would be 1000, but the CPU budget (10 ms) caps it at 100 so
+    # saturation-sized batches still reach the accelerator.
+    assert hybrid.threshold() == 100
+
+    args = lambda n: ([b"\0" * 32] * n, [b"\1" * 32] * n, [b"\2" * 64] * n)
+    hybrid.verify_signatures(*args(5))
+    assert cpu.calls == [5] and tpu.calls == []
+    assert hybrid.backend_label == "hybrid-cpu"
+    hybrid.verify_signatures(*args(256))
+    assert tpu.calls == [256]
+    assert hybrid.backend_label == "hybrid-tpu"
+    # EMAs update from routed dispatches (values sane, not outliers)
+    assert 0 < hybrid.tpu_dispatch_s < 0.2
+    assert hybrid.verify_signatures([], [], []) == []
+
+
+def test_hybrid_verifier_fixed_threshold_and_default():
+    from mysticeti_tpu.block_validator import HybridSignatureVerifier
+
+    h = HybridSignatureVerifier(threshold=7)
+    assert h.threshold() == 7
+    h2 = HybridSignatureVerifier()
+    assert h2.threshold() == h2.DEFAULT_THRESHOLD  # uncalibrated
+
+
+def test_hybrid_verifier_end_to_end_cpu_backends(committee_and_signers):
+    """Hybrid with two CPU oracles behind it is behaviorally identical to the
+    plain CPU path: good blocks pass, forged blocks fail, either route."""
+    committee, signers = committee_and_signers
+    from mysticeti_tpu.block_validator import HybridSignatureVerifier
+
+    async def main():
+        for threshold in (0, 100):  # force tpu-route and cpu-route
+            hybrid = HybridSignatureVerifier(
+                tpu=CpuSignatureVerifier(),
+                cpu=CpuSignatureVerifier(),
+                threshold=threshold,
+            )
+            verifier = BatchedSignatureVerifier(
+                committee, hybrid, max_batch=10, max_delay_s=0.01
+            )
+            good = StatementBlock.build(0, 1, [], (), signer=signers[0])
+            forged = StatementBlock.build(1, 1, [], (), signer=signers[0])
+            results = await asyncio.gather(
+                verifier.verify(good),
+                verifier.verify(forged),
+                return_exceptions=True,
+            )
+            assert results[0] is None
+            assert isinstance(results[1], VerificationError)
+
+    asyncio.run(main())
+
+
 def test_adaptive_batching_window_tracks_dispatch_latency():
     """A remote accelerator (~100ms/dispatch) must widen the collection
     window to a fraction of the observed dispatch latency, so back-to-back

@@ -67,6 +67,10 @@ def test_command_context_background_pidfile():
     assert "setsid nohup" in cmd
     assert "/tmp/.mysticeti-session-node-3.pid" in cmd
     assert "> /tmp/n3.log" in cmd
+    # Spawn is guarded on a live pidfile so SshManager retries cannot
+    # double-spawn the node after a dropped connection.
+    assert cmd.startswith("if [ -f /tmp/.mysticeti-session-node-3.pid ]")
+    assert "kill -0 -- -$(cat /tmp/.mysticeti-session-node-3.pid)" in cmd
 
 
 class FlakyTransport(SshManager):
@@ -135,6 +139,18 @@ def test_static_provider_lifecycle(tmp_path):
 
     run(tb.destroy())
     assert run(provider.list_instances()) == []
+
+
+def test_static_provider_ids_never_reused(tmp_path):
+    """A terminate+create cycle must not hand a new instance a live
+    instance's id (that would silently evict the live host from inventory)."""
+    provider = StaticProvider(["h0", "h1", "h2"], str(tmp_path / "s.json"))
+    first = run(provider.create_instances(2, "local"))
+    run(provider.terminate_instances([first[0].id]))
+    replacement = run(provider.create_instances(1, "local"))[0]
+    live_ids = {i.id for i in run(provider.list_instances())}
+    assert replacement.id not in {first[0].id, first[1].id}
+    assert len(live_ids) == 2
 
 
 def test_static_provider_pool_exhausted(tmp_path):

@@ -105,6 +105,61 @@ def test_monitoring_stack_deploy(tmp_path):
     assert "prometheus" in ds.read_text()
 
 
+def test_monitoring_stack_spawns_grafana(tmp_path, monkeypatch):
+    """start_grafana launches the binary against the generated provisioning
+    tree (monitor.rs:86-104 parity), and stop() reaps it."""
+    import os
+    import stat
+
+    from mysticeti_tpu.orchestrator.monitor import MonitoringStack
+
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    marker = tmp_path / "grafana-started"
+    script = fake_bin / "grafana-server"
+    script.write_text(
+        "#!/bin/sh\n"
+        f"echo \"$GF_PATHS_PROVISIONING\" > {marker}\n"
+        "exec sleep 60\n"
+    )
+    script.chmod(script.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{fake_bin}:{os.environ['PATH']}")
+
+    stack = MonitoringStack(str(tmp_path / "monitor"))
+    stack.deploy(["127.0.0.1:1504"])
+    assert stack.start_grafana()
+    try:
+        assert stack.grafana_proc is not None
+        deadline = 50
+        while not marker.exists() and deadline:
+            import time
+
+            time.sleep(0.1)
+            deadline -= 1
+        prov = marker.read_text().strip()
+        assert prov.endswith("grafana/provisioning")
+        # Dashboard provider path was rewritten from the container default to
+        # the generated tree.
+        provider_yaml = (
+            tmp_path / "monitor" / "grafana" / "provisioning" / "dashboards"
+            / "provider.yaml"
+        ).read_text()
+        assert "/etc/grafana/dashboards" not in provider_yaml
+        assert str(tmp_path / "monitor" / "grafana" / "dashboards") in provider_yaml
+    finally:
+        stack.stop()
+    assert stack.grafana_proc is None
+
+
+def test_monitoring_stack_grafana_absent(tmp_path, monkeypatch):
+    from mysticeti_tpu.orchestrator.monitor import MonitoringStack
+
+    monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+    stack = MonitoringStack(str(tmp_path / "monitor"))
+    stack.deploy(["127.0.0.1:1504"])
+    assert stack.start_grafana() is False
+
+
 def test_monitored_lock(tmp_path):
     import asyncio
 

@@ -70,6 +70,35 @@ async def _wait_commits(validators, minimum, timeout_s):
     await asyncio.wait_for(poll(), timeout=timeout_s)
 
 
+def test_make_verifier_kinds(monkeypatch):
+    """Every --verifier choice constructs (regression: the hybrid wiring
+    once referenced an unimported class and only failed at node boot)."""
+    from mysticeti_tpu import block_validator as bv
+    from mysticeti_tpu.validator import _make_verifier
+
+    # Warmup threads would trace/compile the kernel; wiring is what's tested.
+    monkeypatch.setattr(bv.HybridSignatureVerifier, "warmup", lambda self: None)
+    monkeypatch.setattr(bv.TpuSignatureVerifier, "warmup", lambda self: None)
+    committee = Committee.new_for_benchmarks(4)
+
+    v = _make_verifier("tpu", committee)
+    assert isinstance(v, bv.BatchedSignatureVerifier)
+    assert isinstance(v.verifier, bv.HybridSignatureVerifier)
+    assert isinstance(v.verifier.tpu, bv.TpuSignatureVerifier)
+
+    v = _make_verifier("tpu-only", committee)
+    assert isinstance(v.verifier, bv.TpuSignatureVerifier)
+
+    v = _make_verifier("cpu", committee)
+    assert isinstance(v.verifier, bv.CpuSignatureVerifier)
+
+    assert isinstance(
+        _make_verifier("accept", committee), bv.AcceptAllBlockVerifier
+    )
+    with pytest.raises(ValueError):
+        _make_verifier("gpu", committee)
+
+
 def test_validator_commit(tmp_path):
     """4 validators over localhost TCP commit leaders (validator_commit)."""
 
