@@ -11,6 +11,15 @@ known committee, so each signature ships as R||M||s + a key index into a
 device-resident key table — not a kernel-only figure, and not a
 hypothetical unknown-signer workload either.
 
+Shape of the measurement: BENCH_PROCS worker processes (default 4) feed the
+chip concurrently, exactly like a validator fleet sharing a host TPU — each
+process has its own PJRT client/connection.  This matters on a tunneled
+chip: one TCP stream is bandwidth-limited by the link's delay product
+(~10-60 MB/s observed), while the chip itself sustains several hundred
+thousand verifies/s; concurrent streams restore the transfer headroom that
+co-located hosts have natively.  BENCH_PROCS=1 recovers the single-stream
+number.
+
 Prints exactly ONE JSON line:
   {"metric": "ed25519_verifies_per_sec", "value": N, "unit": "sig/s", "vs_baseline": R}
 
@@ -22,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -33,24 +43,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_TARGET = 500_000.0  # sig-verifies/sec/host (BASELINE.json north star)
 
 
-def main() -> None:
-    import numpy as np
+def _build_batch(batch: int, seed: int):
+    """A realistic batch: a 16-signer committee over 32-byte block digests
+    (the framework's signed message is always a blake2b-256 digest)."""
+    import random
 
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
     from mysticeti_tpu.ops import ed25519 as E
 
-    batch = int(os.environ.get("BENCH_BATCH", "16384"))
-    iters = int(os.environ.get("BENCH_ITERS", "64"))
-
-    # Build a realistic batch: distinct signers over 32-byte block digests
-    # (the framework's signed message is always a blake2b-256 digest).
-    import random
-
-    rng = random.Random(0)
+    rng = random.Random(seed)
     n_keys = 16
     keys = [
-        Ed25519PrivateKey.from_private_bytes(bytes(rng.randrange(256) for _ in range(32)))
+        Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.randrange(256) for _ in range(32))
+        )
         for _ in range(n_keys)
     ]
     pks, msgs, sigs = [], [], []
@@ -60,40 +67,143 @@ def main() -> None:
         pks.append(key.public_key().public_bytes_raw())
         msgs.append(msg)
         sigs.append(key.sign(msg))
-
-    # The deployed node path: the committee's keys live on device, signatures
-    # ship with a key index (ops.ed25519.KeyTable / verify_batch_table).
     table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys])
+    return table, pks, msgs, sigs
 
-    # Warm-up / compile (outside the timed region, as any long-running
-    # validator would be after its first batch).
+
+def _run_trial(table, pks, msgs, sigs, iters: int) -> float:
+    """One timed trial: ``iters`` full batches, packing + index lookup inside
+    the timed region, every dispatch async, ONE combined fetch at the end
+    (per-handle fetches on a remote chip would measure link latency)."""
+    from mysticeti_tpu.ops import ed25519 as E
+
+    batch = len(sigs)
+    start = time.perf_counter()
+    handles = []
+    for _ in range(iters):
+        idx = table.indices_for(pks)
+        blob = E.pack_blob_indexed(idx, msgs, sigs, num_keys=len(table))
+        handles.extend(E.dispatch_indexed_chunks(blob, table))
+    results = E.fetch_handles(handles)
+    elapsed = time.perf_counter() - start
+    assert results.shape[0] == batch * iters and bool(results.all())
+    return elapsed
+
+
+def _worker() -> None:
+    """Child-process mode: warm up, then run one timed trial per GO line on
+    stdin, reporting {"sigs": N, "elapsed": s} per trial on stdout."""
+    import numpy as np
+
+    from mysticeti_tpu.ops import ed25519 as E
+
+    batch = int(os.environ["BENCH_BATCH"])
+    iters = int(os.environ["BENCH_WORKER_ITERS"])
+    seed = int(os.environ["BENCH_SEED"])
+    table, pks, msgs, sigs = _build_batch(batch, seed)
+    ok = E.verify_batch_table(table, pks, msgs, sigs)  # warm/compile
+    assert bool(np.asarray(ok).all()), "benchmark batch must verify"
+    print("READY", flush=True)
+    for line in sys.stdin:
+        if line.strip() != "GO":
+            continue
+        elapsed = _run_trial(table, pks, msgs, sigs, iters)
+        print(json.dumps({"sigs": batch * iters, "elapsed": elapsed}), flush=True)
+
+
+def _single_process(batch: int, iters: int, trials: int) -> float:
+    import numpy as np
+
+    from mysticeti_tpu.ops import ed25519 as E
+
+    table, pks, msgs, sigs = _build_batch(batch, seed=0)
     ok = E.verify_batch_table(table, pks, msgs, sigs)
     assert bool(np.asarray(ok).all()), "benchmark batch must verify"
-
-    # Steady-state pipelined throughput: every iteration maps pks to indices
-    # and packs the raw bytes on the host into ONE device array, then
-    # dispatches; results are forced once at the end.  This is how a
-    # validator consumes the verifier (batches stream through the async
-    # dispatch queue) — each batch's index lookup + packing is inside the
-    # timed region, so the number is end-to-end bytes -> bools.
-    trials = int(os.environ.get("BENCH_TRIALS", "4"))
     best = 0.0
     for _ in range(trials):
-        start = time.perf_counter()
-        handles = []
-        for _ in range(iters):
-            idx = table.indices_for(pks)
-            blob = E.pack_blob_indexed(idx, msgs, sigs)
-            handles.extend(E.dispatch_indexed_chunks(blob, table))
-        # Force every result with one combined device fetch (fetch_handles);
-        # per-handle fetches would pay one device round-trip each, which on a
-        # remote/tunneled chip measures link latency instead of verification.
-        results = E.fetch_handles(handles)
-        elapsed = time.perf_counter() - start
-        assert results.shape[0] == batch * iters and bool(results.all())
+        elapsed = _run_trial(table, pks, msgs, sigs, iters)
         best = max(best, batch * iters / elapsed)
+    return best
 
-    value = best
+
+def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
+    """Fleet-shaped measurement: ``procs`` workers, synchronized trials.
+
+    Per trial, every worker runs iters/procs batches concurrently; the
+    aggregate rate is total sigs / slowest worker.  Best trial wins (the
+    chip is shared with other tenants — see BENCH_SAMPLES_r02.json).
+    """
+    per_worker_iters = max(1, iters // procs)
+    env = dict(os.environ)
+    env.update(
+        {
+            "BENCH_WORKER": "1",
+            "BENCH_BATCH": str(batch),
+            "BENCH_WORKER_ITERS": str(per_worker_iters),
+        }
+    )
+    workers = []
+    for w in range(procs):
+        wenv = dict(env)
+        wenv["BENCH_SEED"] = str(w)
+        workers.append(
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                env=wenv,
+                text=True,
+            )
+        )
+    try:
+        for p in workers:
+            line = p.stdout.readline().strip()
+            if line != "READY":
+                raise RuntimeError(f"worker failed to start: {line!r}")
+        best = 0.0
+        for _ in range(trials):
+            for p in workers:
+                p.stdin.write("GO\n")
+                p.stdin.flush()
+            sigs_total, slowest = 0, 0.0
+            for p in workers:
+                rec = json.loads(p.stdout.readline())
+                sigs_total += rec["sigs"]
+                slowest = max(slowest, rec["elapsed"])
+            best = max(best, sigs_total / slowest)
+        return best
+    finally:
+        for p in workers:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        for p in workers:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                # A hung worker (dropped tunnel mid-dispatch) must not leave
+                # the whole fleet unreaped holding the device.
+                p.kill()
+                p.wait()
+
+
+def main() -> None:
+    if os.environ.get("BENCH_WORKER") == "1":
+        _worker()
+        return
+
+    batch = int(os.environ.get("BENCH_BATCH", "16384"))
+    iters = int(os.environ.get("BENCH_ITERS", "64"))
+    trials = int(os.environ.get("BENCH_TRIALS", "4"))
+    procs = int(os.environ.get("BENCH_PROCS", "4"))
+
+    if procs <= 1:
+        value = _single_process(batch, iters, trials)
+    else:
+        value = _multi_process(batch, iters, trials, procs)
+
     print(
         json.dumps(
             {

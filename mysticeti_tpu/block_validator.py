@@ -188,6 +188,11 @@ class HybridSignatureVerifier(SignatureVerifier):
 
     DEFAULT_THRESHOLD = 32  # until both EMAs are seeded
     MAX_CPU_BUDGET_S = 0.010  # max host time one CPU-routed batch may take
+    # Hard ceiling below the batching collector's max_batch (256): however
+    # fast the CPU measures, collector-full batches must still reach the
+    # accelerator, or a fast core turns "--verifier tpu" into a pure CPU
+    # verifier and the TPU EMA goes stale.
+    MAX_THRESHOLD = 192
     EMA_OUTLIER_S = 5.0  # ignore one-time compile stalls
 
     def __init__(
@@ -212,7 +217,7 @@ class HybridSignatureVerifier(SignatureVerifier):
             return self.DEFAULT_THRESHOLD
         crossover = self.tpu_dispatch_s / self.cpu_per_sig_s
         budget_cap = self.MAX_CPU_BUDGET_S / self.cpu_per_sig_s
-        return max(1, int(min(crossover, budget_cap)))
+        return max(1, min(int(min(crossover, budget_cap)), self.MAX_THRESHOLD))
 
     def warmup(self) -> None:
         from . import crypto

@@ -533,11 +533,154 @@ def verify_fused_indexed_impl(blob: jnp.ndarray, table: jnp.ndarray) -> jnp.ndar
 verify_fused_indexed_kernel = jax.jit(verify_fused_indexed_impl)
 
 
+# ---------------------------------------------------------------------------
+# Keyed-tile path: the committee keys are FIXED at table build time, so each
+# key gets a full positional comb table -(v * 16^w * A) precomputed once —
+# per-signature verification then needs ZERO doublings and NO on-device A
+# decompression (the two dominant costs of the generic ladder: ~252 doublings
+# + a ~250-mul sqrt chain per lane).  Tiles are grouped by key on the host so
+# the Pallas kernel selects one key's comb per tile via scalar prefetch.
+# ---------------------------------------------------------------------------
+
+
+def _ext_add(p, q):
+    """Python-int extended twisted-Edwards addition (add-2008-hwcd-3, a=-1,
+    complete) — table generation only."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = t1 * _D2 % P * t2 % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _ext_double(p):
+    """Python-int dbl-2008-hwcd (a=-1) — table generation only."""
+    x1, y1, z1, _ = p
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = 2 * z1 * z1 % P
+    h = a + b
+    e = h - (x1 + y1) * (x1 + y1)
+    g = a - b
+    f = c + g
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _decode_point(pk32: bytes) -> Optional[Tuple[int, int]]:
+    """RFC 8032 decode of a 32-byte encoding to affine (x, y); None when the
+    encoding is non-canonical or not on the curve."""
+    enc = int.from_bytes(pk32, "little")
+    sign, y = enc >> 255, enc & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return x, y
+
+
+def build_neg_key_combs(public_keys: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    """(K, 64, 3, NLIMBS, 16) int32 Niels-form combs of -(v * 16^w * A_j),
+    plus a (K,) validity mask.
+
+    An invalid key (non-canonical / off-curve encoding) gets identity-only
+    entries and valid=False; the keyed dispatch force-rejects its lanes,
+    matching the generic kernel's decompression failure bit-for-bit.
+
+    Built with python ints; all 960 affine conversions per key share ONE
+    modular inversion (Montgomery batch-inversion), so a 100-key committee
+    builds in seconds, once.
+    """
+    K = len(public_keys)
+    out = np.zeros((K, _WINDOWS, 3, F.NLIMBS, 16), np.int32)
+    valid = np.zeros(K, bool)
+    one = F.int_to_limbs(1)
+    # v=0 entries are the identity's Niels form (1, 1, 0) for every window.
+    out[:, :, 0, :, 0] = one
+    out[:, :, 1, :, 0] = one
+    for j, pk in enumerate(public_keys):
+        dec = _decode_point(bytes(pk))
+        if dec is None:
+            continue
+        valid[j] = True
+        x, y = dec
+        step = (x, y, 1, x * y % P)  # 16^w * A in extended coords
+        entries = []  # (w, v, point)
+        for w in range(_WINDOWS):
+            entry = step
+            for v in range(1, 16):
+                entries.append((w, v, entry))
+                entry = _ext_add(entry, step)
+            for _ in range(4):
+                step = _ext_double(step)
+        # Montgomery batch inversion of every Z.
+        prefix = [1]
+        for _, _, (_, _, z, _) in entries:
+            prefix.append(prefix[-1] * z % P)
+        inv = pow(prefix[-1], P - 2, P)
+        for i in range(len(entries) - 1, -1, -1):
+            w, v, (ex, ey, ez, _) = entries[i]
+            zi = prefix[i] * inv % P
+            inv = inv * ez % P
+            xa, ya = ex * zi % P, ey * zi % P
+            # Niels form of the NEGATED point (-xa, ya):
+            out[j, w, 0, :, v] = F.int_to_limbs((ya + xa) % P)  # y - (-x)
+            out[j, w, 1, :, v] = F.int_to_limbs((ya - xa) % P)  # y + (-x)
+            out[j, w, 2, :, v] = F.int_to_limbs(
+                (P - _D2 * xa % P * ya % P) % P  # 2d * (-x) * y
+            )
+    return out, valid
+
+
+def group_blob_for_tiles(
+    blob: np.ndarray, num_keys: int, tile: int, bucket: int
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Rearrange an indexed blob so every ``tile``-lane tile holds one key.
+
+    Returns (grouped (bucket, C), tile_keys (bucket//tile,) int32,
+    positions (n,) int32 — row of each original item in the grouped layout),
+    or None when the per-key padding cannot fit the bucket (callers fall back
+    to the generic kernel).  Padded lanes are zero rows (host_ok=0).
+    """
+    n = blob.shape[0]
+    ntiles = bucket // tile
+    idx = blob[:, 24].astype(np.int64)
+    ok = blob[:, 25] != 0
+    # Rejected/unknown lanes carry no constraint (host_ok=0 forces False);
+    # park them under key 0.
+    key = np.where(ok, np.clip(idx, 0, num_keys - 1), 0)
+    counts = np.bincount(key, minlength=num_keys)
+    tiles_per_key = -(-counts // tile)
+    if int(tiles_per_key.sum()) > ntiles:
+        return None
+    tile_starts = np.zeros(num_keys, np.int64)
+    np.cumsum(tiles_per_key[:-1] * tile, out=tile_starts[1:])
+    order = np.argsort(key, kind="stable")
+    csum = np.zeros(num_keys, np.int64)
+    np.cumsum(counts[:-1], out=csum[1:])
+    rank_sorted = np.arange(n, dtype=np.int64) - np.repeat(csum, counts)
+    positions = np.empty(n, np.int64)
+    positions[order] = tile_starts[key[order]] + rank_sorted
+    grouped = np.zeros((bucket, blob.shape[1]), blob.dtype)
+    grouped[positions] = blob
+    tile_keys = np.zeros(ntiles, np.int32)
+    tile_keys[: int(tiles_per_key.sum())] = np.repeat(
+        np.arange(num_keys), tiles_per_key
+    )
+    return grouped, tile_keys, positions.astype(np.int32)
+
+
 class KeyTable:
     """A committee's keys resident on device: upload once, verify by index.
 
     ``indices_for`` maps raw pk bytes to table rows; unknown keys map to -1
-    (callers mask them out or route them through the generic path)."""
+    (callers mask them out or route them through the generic path).
+
+    ``neg_combs`` lazily builds the per-key negated comb tables for the
+    keyed-tile Pallas kernel (see build_neg_key_combs)."""
 
     def __init__(self, public_keys: Sequence[bytes]) -> None:
         if not public_keys:
@@ -546,6 +689,8 @@ class KeyTable:
             raise ValueError("key table entries must be 32-byte encodings")
         self.words = jnp.asarray(pk_table_words(public_keys))
         self._index = {pk: i for i, pk in enumerate(public_keys)}
+        self._keys = [bytes(pk) for pk in public_keys]
+        self._neg_combs: Optional[Tuple[jnp.ndarray, np.ndarray]] = None
 
     def __len__(self) -> int:
         return self.words.shape[0]
@@ -557,6 +702,13 @@ class KeyTable:
             count=len(public_keys),
         )
 
+    def neg_combs(self) -> Tuple[jnp.ndarray, np.ndarray]:
+        """(device (K, 64, 3, NLIMBS, 16) comb array, (K,) host valid mask)."""
+        if self._neg_combs is None:
+            arr, valid = build_neg_key_combs(self._keys)
+            self._neg_combs = (jnp.asarray(arr), valid)
+        return self._neg_combs
+
 
 def _dispatch_indexed(blob, table) -> jnp.ndarray:
     if _backend() == "pallas":
@@ -566,18 +718,48 @@ def _dispatch_indexed(blob, table) -> jnp.ndarray:
     return verify_fused_indexed_kernel(blob, table)
 
 
+def _dispatch_indexed_keyed(chunk: np.ndarray, table: "KeyTable", bucket: int):
+    """Keyed-tile Pallas dispatch (zero doublings, no A decompression);
+    returns None when the per-key tile padding doesn't fit the bucket —
+    callers fall back to the generic ladder."""
+    from . import ed25519_pallas as PK
+
+    tile = min(PK.default_tile(), bucket)
+    acomb, valid = table.neg_combs()
+    if not valid.all():
+        # Lanes under an off-curve committee key must reject exactly like the
+        # generic kernel's decompression failure; the identity comb entries
+        # would otherwise turn them into an [s]B == R check.
+        chunk = chunk.copy()
+        keyv = np.clip(chunk[:, 24].astype(np.int64), 0, len(valid) - 1)
+        chunk[:, 25] &= valid[keyv]
+    g = group_blob_for_tiles(chunk, len(table), tile, bucket)
+    if g is None:
+        return None
+    grouped, tile_keys, positions = g
+    return PK.verify_keyed_blob(
+        grouped, table.words, acomb, tile_keys, _pad_to(positions, bucket),
+        tile=tile,
+    )
+
+
 def dispatch_indexed_chunks(blob: np.ndarray, table: "KeyTable"):
     """Bucket-shaped async dispatch of an indexed blob (pack_blob_indexed
-    layout); returns [(count, handle)] for fetch_handles."""
-    return [
-        (
-            count,
-            _dispatch_indexed(
-                jnp.asarray(_pad_to(blob[start : start + count], b)), table.words
-            ),
-        )
-        for start, count, b in iter_buckets(blob.shape[0])
-    ]
+    layout); returns [(count, handle)] for fetch_handles.
+
+    On the Pallas backend each chunk takes the keyed-tile kernel when its
+    per-key grouping fits the bucket (the common case: committee authorship
+    is roughly uniform), falling back to the generic ladder otherwise.
+    MYSTICETI_KEYED=0 disables the keyed path."""
+    keyed = _backend() == "pallas" and os.environ.get("MYSTICETI_KEYED") != "0"
+    handles = []
+    for start, count, b in iter_buckets(blob.shape[0]):
+        chunk = blob[start : start + count]
+        h = _dispatch_indexed_keyed(chunk, table, b) if keyed else None
+        if h is None:
+            h = _dispatch_indexed(jnp.asarray(_pad_to(chunk, b)), table.words)
+        handles.append((count, h))
+    return handles
 
 
 def verify_batch_table(

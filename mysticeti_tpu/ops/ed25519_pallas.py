@@ -473,6 +473,152 @@ def _verify_pallas_jit(
     return out[0].astype(bool)
 
 
+# ---------------------------------------------------------------------------
+# Keyed-tile kernel: every tile holds signatures of ONE committee key, whose
+# precomputed negated comb (ops.ed25519.build_neg_key_combs) is DMA'd into
+# VMEM via a scalar-prefetched index.  [s]B + [k](-A) is then 128 Niels
+# additions — zero doublings, no on-device A decompression — roughly a third
+# of the generic ladder's field multiplications.
+# ---------------------------------------------------------------------------
+
+
+def _verify_keyed_body(
+    keys_ref,
+    consts_ref,
+    bcomb_ref,
+    acomb_ref,
+    r_y_ref,
+    r_sign_ref,
+    s_w_ref,
+    k_w_ref,
+    host_ok_ref,
+    out_ref,
+):
+    del keys_ref  # consumed by acomb's index_map; the body never reads it
+    t = r_y_ref.shape[1]
+    _bind_consts(consts_ref)
+
+    def step(i, acc):
+        acc = point_madd(acc, _gather_comb(bcomb_ref[i], s_w_ref[pl.ds(i, 1), :]))
+        acc = point_madd(
+            acc, _gather_comb(acomb_ref[0, i], k_w_ref[pl.ds(i, 1), :])
+        )
+        return acc
+
+    res = jax.lax.fori_loop(0, 64, step, _identity(t))
+    x, y, z, _ = res
+    zinv = finv(z)
+    x_aff = fmul(x, zinv)
+    y_aff = fmul(y, zinv)
+    # Exact compare on the raw R limbs (memcmp semantics, see _verify_body).
+    match = feq(y_aff, r_y_ref[...]) & (fparity(x_aff) == r_sign_ref[...])
+    ok = match & (host_ok_ref[...] != 0)
+    out_ref[...] = ok.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_keyed_pallas_jit(
+    tile_keys, acomb, r_y, r_sign, s_w, k_w, host_ok, positions, *, tile, interpret
+):
+    b = r_y.shape[0]
+    grid = (b // tile,)
+    col = lambda i, keys: (0, i)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (7, NLIMBS, tile),
+                lambda i, keys: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (64, 3, NLIMBS, 16),
+                lambda i, keys: (0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            # The tile's key selects which comb is DMA'd; consecutive tiles
+            # sharing a key (the grouped layout sorts them) skip the copy.
+            pl.BlockSpec(
+                (1, 64, 3, NLIMBS, 16),
+                lambda i, keys: (keys[i], 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((NLIMBS, tile), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((64, tile), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((64, tile), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), col, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile), col, memory_space=pltpu.VMEM),
+    )
+    kernel = pl.pallas_call(
+        _verify_keyed_body,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        interpret=interpret,
+    )
+    out = kernel(
+        tile_keys,
+        jnp.asarray(_consts_wide(tile)),
+        jnp.asarray(_COMB_T),
+        acomb,
+        r_y.T,
+        r_sign[None, :].astype(jnp.int32),
+        s_w.T,
+        k_w.T,
+        host_ok[None, :].astype(jnp.int32),
+    )
+    # Un-permute back to the caller's order on device (positions maps
+    # original row -> grouped row); padding lanes are dropped by the caller.
+    return jnp.take(out[0], positions).astype(bool)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_keyed_blob_jit(blob, table, acomb, tile_keys, positions, *, tile, interpret):
+    # A-word gather + SHA-512 + parse in XLA; the a_y/a_sign outputs of
+    # prepare_fused are dead here (no decompression) and DCE'd by XLA.
+    msg_words, s_words, host_ok = E.indexed_to_msg_words(blob, table)
+    _a_y, _a_sign, r_y, r_sign, s_w, k_w, ok = E.prepare_fused(
+        msg_words, s_words, host_ok
+    )
+    return _verify_keyed_pallas_jit(
+        tile_keys, acomb, r_y, r_sign, s_w, k_w, ok, positions,
+        tile=tile, interpret=interpret,
+    )
+
+
+def verify_keyed_blob(
+    grouped,
+    table_words,
+    acomb,
+    tile_keys,
+    positions,
+    *,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Keyed-tile fused verification of a GROUPED indexed blob
+    (ops.ed25519.group_blob_for_tiles layout).  Returns (b,) bool in the
+    ORIGINAL (pre-grouping) order, padding lanes last."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile is None:
+        tile = default_tile()
+    b = grouped.shape[0]
+    if b % tile != 0:
+        raise ValueError(f"batch {b} not a multiple of tile {tile}")
+    return _verify_keyed_blob_jit(
+        jnp.asarray(grouped),
+        jnp.asarray(table_words),
+        jnp.asarray(acomb),
+        jnp.asarray(tile_keys),
+        jnp.asarray(positions),
+        tile=tile,
+        interpret=interpret,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def _verify_fused_pallas_jit(msg_words, s_words, host_ok, *, tile, interpret):
     # Parse/hash/reduce in XLA (cheap, fuses well), ladder in Pallas (VMEM).

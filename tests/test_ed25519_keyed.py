@@ -1,0 +1,163 @@
+"""Keyed-tile verification path: per-key precomputed combs + tile grouping.
+
+The keyed kernel must be bit-identical to the CPU oracle and the generic
+fused path — it is a pure strength reduction (zero doublings, no on-device A
+decompression), not a semantics change.  Runs under the Pallas interpreter
+on the CPU test mesh.
+"""
+import random
+
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from mysticeti_tpu.ops import ed25519 as E
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def keyring():
+    rng = random.Random(7)
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.randrange(256) for _ in range(32))
+        )
+        for _ in range(4)
+    ]
+    return rng, keys
+
+
+def _batch(rng, keys, n, tamper_every=None):
+    pks, msgs, sigs, expect = [], [], [], []
+    for i in range(n):
+        k = keys[i % len(keys)]
+        m = bytes(rng.randrange(256) for _ in range(32))
+        s = k.sign(m)
+        good = True
+        if tamper_every and i % tamper_every == 0:
+            s = bytes([s[0] ^ 1]) + s[1:]
+            good = False
+        pks.append(k.public_key().public_bytes_raw())
+        msgs.append(m)
+        sigs.append(s)
+        expect.append(good)
+    return pks, msgs, sigs, np.array(expect)
+
+
+def test_group_blob_for_tiles_properties():
+    rng = np.random.default_rng(3)
+    n, num_keys, tile, bucket = 50, 5, 4, 128
+    idx = rng.integers(0, num_keys, size=n)
+    blob = rng.integers(1, 2**31, size=(n, 26), dtype=np.int64).astype(np.uint32)
+    blob[:, 24] = idx
+    blob[:, 25] = 1
+    blob[::11, 25] = 0  # some rejected lanes
+    g = E.group_blob_for_tiles(blob, num_keys, tile, bucket)
+    assert g is not None
+    grouped, tile_keys, positions = g
+    assert grouped.shape == (bucket, 26) and len(tile_keys) == bucket // tile
+    # positions is injective and the grouped rows hold the original data
+    assert len(set(positions.tolist())) == n
+    assert (grouped[positions] == blob).all()
+    # every tile contains rows of ONE key (among live lanes)
+    for t in range(bucket // tile):
+        rows = grouped[t * tile : (t + 1) * tile]
+        live = rows[rows[:, 25] != 0]
+        if len(live):
+            assert (live[:, 24] == tile_keys[t]).all()
+    # overflow: 5 keys x 1 tile minimum > 1-tile bucket
+    assert E.group_blob_for_tiles(blob, num_keys, tile, tile) is None
+
+
+def test_keyed_kernel_matches_oracle(keyring):
+    from mysticeti_tpu.ops import ed25519_pallas as PK
+
+    rng, keys = keyring
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys])
+    n, tile, bucket = 24, 8, 64
+    pks, msgs, sigs, expect = _batch(rng, keys, n, tamper_every=5)
+    idx = table.indices_for(pks)
+    blob = E.pack_blob_indexed(idx, msgs, sigs, num_keys=len(table))
+    acomb, valid = table.neg_combs()
+    assert valid.all()
+    g = E.group_blob_for_tiles(blob, len(table), tile, bucket)
+    assert g is not None
+    grouped, tile_keys, positions = g
+    out = np.asarray(
+        PK.verify_keyed_blob(
+            grouped, table.words, acomb, tile_keys,
+            E._pad_to(positions, bucket), tile=tile, interpret=True,
+        )
+    )[:n]
+    assert (out == expect).all()
+    # parity with the CPU oracle
+    from cryptography.exceptions import InvalidSignature
+
+    for i in range(n):
+        try:
+            keys[i % len(keys)].public_key().verify(sigs[i], msgs[i])
+            oracle = True
+        except InvalidSignature:
+            oracle = False
+        assert out[i] == oracle
+
+
+def test_keyed_dispatch_end_to_end_forced_pallas(keyring, monkeypatch):
+    """verify_batch_table with the backend forced to pallas(interpret) takes
+    the keyed dispatch path and still matches expectations, including
+    unknown-key stragglers."""
+    rng, keys = keyring
+    monkeypatch.setenv("MYSTICETI_VERIFY_BACKEND", "pallas")
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys[:-1]])
+    pks, msgs, sigs, expect = _batch(rng, keys, 40, tamper_every=7)
+    out = E.verify_batch_table(table, pks, msgs, sigs)
+    assert (out == expect).all()
+
+
+def test_keyed_rejects_invalid_committee_key(keyring):
+    """An off-curve key table entry force-rejects its lanes (the generic
+    kernel rejects them via decompression failure — outputs must agree)."""
+    from mysticeti_tpu.ops import ed25519_pallas as PK
+
+    rng, keys = keyring
+    bad_pk = bytes([0xFF] * 31 + [0x7F])  # y >= p: non-canonical encoding
+    assert E._decode_point(bad_pk) is None
+    table = E.KeyTable([keys[0].public_key().public_bytes_raw(), bad_pk])
+    acomb, valid = table.neg_combs()
+    assert valid.tolist() == [True, False]
+    n, tile, bucket = 8, 8, 32
+    pks, msgs, sigs, expect = _batch(rng, keys[:1], n)
+    # route half the lanes to the invalid key
+    idx = np.array([0, 1] * (n // 2))
+    blob = E.pack_blob_indexed(idx, msgs, sigs, num_keys=len(table))
+    blob[:, 25] &= valid[np.clip(blob[:, 24].astype(np.int64), 0, 1)]
+    g = E.group_blob_for_tiles(blob, len(table), tile, bucket)
+    grouped, tile_keys, positions = g
+    out = np.asarray(
+        PK.verify_keyed_blob(
+            grouped, table.words, acomb, tile_keys,
+            E._pad_to(positions, bucket), tile=tile, interpret=True,
+        )
+    )[:n]
+    assert (out == (idx == 0) & expect).all()
+
+
+def test_neg_combs_first_window_is_negated_key(keyring):
+    """Spot-check the comb contents: entry (w=0, v=1) must be the Niels form
+    of -A itself."""
+    _, keys = keyring
+    pk = keys[0].public_key().public_bytes_raw()
+    table = E.KeyTable([pk])
+    acomb, valid = table.neg_combs()
+    assert valid.all()
+    x, y = E._decode_point(pk)
+    import mysticeti_tpu.ops.field as F
+
+    arr = np.asarray(acomb)
+    assert (arr[0, 0, 0, :, 1] == F.int_to_limbs((y + x) % E.P)).all()
+    assert (arr[0, 0, 1, :, 1] == F.int_to_limbs((y - x) % E.P)).all()
+    assert (
+        arr[0, 0, 2, :, 1]
+        == F.int_to_limbs((E.P - E._D2 * x % E.P * y % E.P) % E.P)
+    ).all()
