@@ -167,8 +167,16 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
                 p.stdin.write("GO\n")
                 p.stdin.flush()
             sigs_total, slowest = 0, 0.0
-            for p in workers:
-                rec = json.loads(p.stdout.readline())
+            for w, p in enumerate(workers):
+                line = p.stdout.readline()
+                if not line.strip():
+                    # Worker died mid-trial (OOM / PJRT client crash): name
+                    # it rather than failing on the empty JSON parse.
+                    raise RuntimeError(
+                        f"bench worker {w} died mid-trial "
+                        f"(exit code {p.poll()})"
+                    )
+                rec = json.loads(line)
                 sigs_total += rec["sigs"]
                 slowest = max(slowest, rec["elapsed"])
             best = max(best, sigs_total / slowest)

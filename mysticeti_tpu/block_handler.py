@@ -142,16 +142,20 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
             processed = self.transaction_votes.process_block(
                 block, response if require_response else None, self.committee
             )
-            if self.metrics is not None:
+            if self.metrics is not None and processed:
                 with self._time_lock:
-                    for locator in processed:
-                        created = self.transaction_time.get(locator)
-                        if created is not None:
-                            latency = max(0.0, now - created)
-                            self.metrics.latency_s.labels("owned").observe(latency)
-                            self.metrics.latency_squared_s.labels("owned").inc(
-                                latency**2
-                            )
+                    latencies = [
+                        max(0.0, now - created)
+                        for locator in processed
+                        if (created := self.transaction_time.get(locator))
+                        is not None
+                    ]
+                if latencies:
+                    import numpy as np
+
+                    self.metrics.observe_latency_batch(
+                        "owned", np.asarray(latencies)
+                    )
         if self.metrics is not None:
             self.metrics.block_handler_pending_certificates.set(
                 len(self.transaction_votes)

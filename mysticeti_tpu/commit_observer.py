@@ -74,31 +74,39 @@ class TestCommitObserver(CommitObserver):
     def handle_commit(self, committed_leaders):
         now = time.time()
         committed = self.commit_interpreter.handle_commit(committed_leaders)
+        txs: List[bytes] = []
         for commit in committed:
             self.committed_leaders.append(commit.anchor)
             for block in commit.blocks:
                 if not self.consensus_only:
                     self.transaction_votes.process_block(block, None, self.committee)
                 if self.metrics is not None:
-                    for locator, transaction in block.shared_transactions():
-                        self._update_metrics(transaction, now)
+                    txs.extend(t for _, t in block.shared_transactions())
+        if txs:
+            self._update_metrics_batch(txs, now)
         return committed
 
-    def _update_metrics(self, transaction: bytes, now: float) -> None:
-        """Benchmark metrics (commit_observer.rs:104-140): latency measured from
-        the 8-byte submission timestamp the generator prefixes to each tx."""
+    def _update_metrics_batch(self, transactions: List[bytes], now: float) -> None:
+        """Benchmark metrics (commit_observer.rs:104-140): latency measured
+        from the 8-byte float64 submission timestamp the generator prefixes to
+        each tx.  One vectorized update per commit batch — the per-transaction
+        version dominated the engine profile at load (observed: a third of
+        handle_commit's time went to prometheus label lookups + observes)."""
+        import numpy as np
+
         if self._bench_t0 is None:
             self._bench_t0 = time.monotonic()
         elapsed = time.monotonic() - self._bench_t0
         delta = int(elapsed) - int(self.metrics.benchmark_duration._value.get())
         if delta > 0:
             self.metrics.benchmark_duration.inc(delta)
-        from .transactions_generator import TransactionGenerator
-
-        ts = TransactionGenerator.extract_timestamp(transaction)
-        latency = max(0.0, now - ts) if ts else 0.0
-        self.metrics.latency_s.labels("shared").observe(latency)
-        self.metrics.latency_squared_s.labels("shared").inc(latency**2)
+        heads = b"".join(
+            t[:8] if len(t) >= 8 else b"\x00" * 8 for t in transactions
+        )
+        ts = np.frombuffer(heads, "<f8")
+        latencies = np.maximum(0.0, now - ts)
+        latencies[ts == 0.0] = 0.0  # unstamped txs count as zero latency
+        self.metrics.observe_latency_batch("shared", latencies)
 
     def aggregator_state(self) -> bytes:
         return self.transaction_votes.state()

@@ -16,6 +16,7 @@ Capability parity with ``mysticeti-core/src/committee.rs``:
 from __future__ import annotations
 
 import hashlib
+import struct
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import crypto
@@ -312,10 +313,59 @@ class TransactionAggregator:
         self.pending: Dict[BlockReference, RangeMap] = {}
         self.track_processed = track_processed
         self.processed: Set[TransactionLocator] = set()
+        # Native hot core (native/mysticeti_native.cpp VoteAggregator): the
+        # per-offset Python objects (locator tuples, StakeAggregator
+        # instances, set hashing) dominate the engine profile at load, so the
+        # sweep/tally/processed-set state lives in C++ when the extension is
+        # available.  Pure-Python `pending`/`processed` above are the
+        # fallback; MYSTICETI_NO_NATIVE=1 pins it.
+        from .native import native as _native
+
+        self._nat = None
+        self._nat_mod = _native
+        if _native is not None and hasattr(_native, "va_new"):
+            self._nat = _native.va_new(track_processed, 0 if kind == QUORUM else 1)
+            self._refs: Dict[bytes, BlockReference] = {}
+            self._nat_committee: Optional[Committee] = None
+
+    @staticmethod
+    def _key(block: BlockReference) -> bytes:
+        return struct.pack("<QQ", block.authority, block.round) + block.digest
+
+    def _nat_bind(self, committee: Committee) -> None:
+        if self._nat_committee is not committee:
+            threshold = (
+                committee.quorum_threshold()
+                if self.kind == QUORUM
+                else committee.validity_threshold()
+            )
+            self._nat_mod.va_bind(
+                self._nat,
+                [committee.get_stake(a) for a in committee.authority_indexes()],
+                threshold,
+            )
+            self._nat_committee = committee
+
+    def _raise_violations(self, viol_ranges, block, vote, hook) -> None:
+        """Feed native violation ranges through the overridable handler hook
+        offset-by-offset, deferring exceptions to the end — exact parity with
+        the pure path's sweep (every violating offset observes the hook; the
+        first collected exception is raised after the map update completed)."""
+        violations: List[Exception] = []
+        for s, e in viol_ranges:
+            for off in range(s, e):
+                try:
+                    hook(TransactionLocator(block, off), vote)
+                except Exception as exc:  # noqa: BLE001 - deferred, re-raised
+                    violations.append(exc)
+        if violations:
+            raise violations[0]
 
     # handler hooks — overridable by subclasses
     def transaction_processed(self, k: TransactionLocator) -> None:
-        if self.track_processed:
+        # The native core records certified intervals itself; the Python set
+        # only backs the fallback path.
+        if self.track_processed and self._nat is None:
             self.processed.add(k)
 
     def duplicate_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
@@ -327,6 +377,10 @@ class TransactionAggregator:
             raise RuntimeError(f"vote for unknown transaction {k} from {from_}")
 
     def is_processed(self, k: TransactionLocator) -> bool:
+        if self._nat is not None:
+            return self._nat_mod.va_is_processed(
+                self._nat, self._key(k.block), k.offset
+            )
         return k in self.processed
 
     # -- core operations (committee.rs:364-425) --
@@ -345,6 +399,22 @@ class TransactionAggregator:
         leave ``pending`` partially mutated, and unlike the reference (which aborts
         the process on these panics) a Python caller may catch and continue, so the
         aggregator must stay internally consistent."""
+        if self._nat is not None:
+            block = locator_range.block
+            key = self._key(block)
+            self._refs[key] = block
+            self._nat_bind(committee)
+            viol_ranges = self._nat_mod.va_register(
+                self._nat,
+                key,
+                locator_range.offset_start_inclusive,
+                locator_range.offset_end_exclusive,
+                vote,
+            )
+            self._raise_violations(
+                viol_ranges, block, vote, self.duplicate_transaction
+            )
+            return
         range_map = self.pending.setdefault(locator_range.block, RangeMap())
         violations: List[Exception] = []
 
@@ -377,6 +447,28 @@ class TransactionAggregator:
         committee: Committee,
         processed_out: List[TransactionLocator],
     ) -> None:
+        if self._nat is not None:
+            block = locator_range.block
+            key = self._key(block)
+            self._nat_bind(committee)
+            certified, viol_ranges, retired = self._nat_mod.va_vote(
+                self._nat,
+                key,
+                locator_range.offset_start_inclusive,
+                locator_range.offset_end_exclusive,
+                vote,
+            )
+            if retired:
+                self._refs.pop(key, None)
+            for s, e in certified:
+                for off in range(s, e):
+                    k = TransactionLocator(block, off)
+                    self.transaction_processed(k)
+                    processed_out.append(k)
+            self._raise_violations(
+                viol_ranges, block, vote, self.unknown_transaction
+            )
+            return
         range_map = self.pending.get(locator_range.block)
         if range_map is None:
             for loc in locator_range.locators():
@@ -445,14 +537,18 @@ class TransactionAggregator:
         return processed
 
     def __len__(self) -> int:
+        if self._nat is not None:
+            return self._nat_mod.va_pending_len(self._nat)
         return len(self.pending)
 
     def is_empty(self) -> bool:
-        return not self.pending
+        return len(self) == 0
 
     # -- state snapshot (committee.rs:352-362), our own encoding --
 
     def state(self) -> bytes:
+        if self._nat is not None:
+            return self._nat_state()
         w = Writer()
         w.u32(len(self.pending))
         for block_ref in sorted(self.pending):
@@ -464,8 +560,26 @@ class TransactionAggregator:
                 agg.encode(w)
         return w.finish()
 
+    def _nat_state(self) -> bytes:
+        # Byte-identical to the pure-Python encoder: the native sweep splits
+        # ranges exactly like RangeMap.mutate_range, so the item lists match.
+        items = self._nat_mod.va_items(self._nat)
+        by_ref = sorted(
+            (self._refs[key], ranges) for key, ranges in items
+        )
+        w = Writer()
+        w.u32(len(by_ref))
+        for block_ref, ranges in by_ref:
+            block_ref.encode(w)
+            w.u32(len(ranges))
+            for s, e, stake, kind, mask in ranges:
+                w.u64(s).u64(e)
+                w.u8(kind).u64(stake)
+                w.bytes(mask)
+        return w.finish()
+
     def with_state(self, state: bytes) -> None:
-        if self.pending:
+        if len(self):
             raise RuntimeError("with_state requires an empty aggregator")
         r = Reader(state)
         for _ in range(r.u32()):
@@ -474,9 +588,18 @@ class TransactionAggregator:
             n = r.u32()
             for _ in range(n):
                 s, e = r.u64(), r.u64()
-                agg = StakeAggregator.decode(r)
-                rm.mutate_range(s, e, lambda a, b, _old, agg=agg: agg)
-            self.pending[block_ref] = rm
+                if self._nat is not None:
+                    kind = r.u8()
+                    stake = r.u64()
+                    mask = r.bytes()
+                    key = self._key(block_ref)
+                    self._refs[key] = block_ref
+                    self._nat_mod.va_load(self._nat, key, s, e, stake, kind, mask)
+                else:
+                    agg = StakeAggregator.decode(r)
+                    rm.mutate_range(s, e, lambda a, b, _old, agg=agg: agg)
+            if self._nat is None:
+                self.pending[block_ref] = rm
         r.expect_done()
 
 

@@ -180,6 +180,42 @@ class Metrics:
         self.quorum_receive_latency = PreciseHistogram()
         self._precise["quorum_receive_latency"] = self.quorum_receive_latency
 
+    def observe_latency_batch(self, workload: str, latencies) -> None:
+        """Vectorized ``latency_s.observe`` + ``latency_squared_s.inc`` over a
+        numpy array of samples — one bucket-count pass instead of a labels()
+        lookup and a 16-bucket walk per transaction (the per-tx path dominated
+        the commit observer at load).  Falls back to the plain loop if the
+        prometheus_client internals ever change shape.
+        """
+        import numpy as np
+
+        key = ("latency_batch", workload)
+        cached = self.__dict__.get(key)
+        if cached is None:
+            cached = (
+                self.latency_s.labels(workload),
+                self.latency_squared_s.labels(workload),
+            )
+            self.__dict__[key] = cached
+        hist, squared = cached
+        squared.inc(float(np.square(latencies).sum()))
+        try:
+            ubs = hist._upper_bounds  # finite bounds + +Inf last
+            buckets = hist._buckets
+            total = hist._sum
+        except AttributeError:  # pragma: no cover - client internals moved
+            for v in latencies:
+                hist.observe(float(v))
+            return
+        # le-semantics: first upper bound >= sample (side="left" keeps
+        # boundary samples in their bucket, matching observe()).
+        idx = np.searchsorted(np.asarray(ubs[:-1]), latencies, side="left")
+        counts = np.bincount(idx, minlength=len(ubs))
+        for i, c in enumerate(counts):
+            if c:
+                buckets[i].inc(int(c))
+        total.inc(float(latencies.sum()))
+
     @contextmanager
     def utilization_timer(self, proc: str):
         """Drop-guard busy counter (metrics.rs:615-666)."""

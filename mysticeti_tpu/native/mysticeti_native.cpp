@@ -16,8 +16,12 @@
 
 #include <zlib.h>
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -142,11 +146,467 @@ PyObject* frame_entry(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// VoteAggregator — the TransactionAggregator hot core (committee.rs:364-482
+// analog).  Replaces the per-offset Python objects (TransactionLocator
+// namedtuples, StakeAggregator instances, set hashing) that dominate the
+// engine profile at load.  Semantics mirror mysticeti_tpu/committee.py
+// exactly, including RangeMap's split-on-overlap behavior (range_map.py:38),
+// so state() snapshots are byte-identical to the pure-Python path.
+// ---------------------------------------------------------------------------
+
+constexpr int kMaskWords = 8;  // 512-bit authority mask (AuthoritySet cap)
+
+struct VaEntry {
+  uint64_t start, end;  // half-open offset range
+  uint64_t stake;
+  uint8_t kind;  // 0 quorum / 1 validity (round-trips the state encoding)
+  uint64_t mask[kMaskWords];
+};
+
+struct VaBlock {
+  std::vector<VaEntry> ranges;               // sorted, disjoint, non-empty
+  std::map<uint64_t, uint64_t> processed;    // merged [start, end) intervals
+};
+
+struct VoteAgg {
+  bool track_processed = true;
+  bool bound = false;
+  uint8_t kind = 0;
+  std::vector<uint64_t> stakes;
+  uint64_t threshold = 0;
+  std::unordered_map<std::string, VaBlock> blocks;
+  size_t pending_count = 0;  // blocks with non-empty ranges
+};
+
+void va_destroy(PyObject* cap) {
+  delete static_cast<VoteAgg*>(PyCapsule_GetPointer(cap, "mysticeti.va"));
+}
+
+VoteAgg* va_from(PyObject* cap) {
+  return static_cast<VoteAgg*>(PyCapsule_GetPointer(cap, "mysticeti.va"));
+}
+
+// Merged-interval helpers over VaBlock::processed.
+void processed_mark(VaBlock& b, uint64_t s, uint64_t e) {
+  auto it = b.processed.upper_bound(s);
+  if (it != b.processed.begin()) {
+    --it;
+    if (it->second >= s) {
+      s = it->first;
+      e = std::max(e, it->second);
+      it = b.processed.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  while (it != b.processed.end() && it->first <= e) {
+    e = std::max(e, it->second);
+    it = b.processed.erase(it);
+  }
+  b.processed.emplace(s, e);
+}
+
+bool processed_contains(const VaBlock& b, uint64_t off) {
+  auto it = b.processed.upper_bound(off);
+  if (it == b.processed.begin()) return false;
+  --it;
+  return it->first <= off && off < it->second;
+}
+
+// Append the sub-intervals of [s, e) NOT in the processed set.  These are
+// the violation ranges the Python wrapper feeds through the overridable
+// handler hooks offset-by-offset — exact parity with the pure path, which
+// calls the hook for every violating offset.
+void unprocessed_intervals(const VaBlock& b, uint64_t s, uint64_t e,
+                           std::vector<std::pair<uint64_t, uint64_t>>& out) {
+  uint64_t cur = s;
+  while (cur < e) {
+    auto it = b.processed.upper_bound(cur);
+    if (it != b.processed.begin()) {
+      auto prev = std::prev(it);
+      if (prev->first <= cur && cur < prev->second) {
+        cur = prev->second;
+        continue;
+      }
+    }
+    uint64_t gap_end = e;
+    if (it != b.processed.end()) gap_end = std::min(gap_end, it->first);
+    if (cur < gap_end) out.emplace_back(cur, gap_end);
+    cur = gap_end;
+  }
+}
+
+PyObject* intervals_to_list(
+    const std::vector<std::pair<uint64_t, uint64_t>>& ivs) {
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) return nullptr;
+  for (auto& iv : ivs) {
+    PyObject* item =
+        Py_BuildValue("(KK)", static_cast<unsigned long long>(iv.first),
+                      static_cast<unsigned long long>(iv.second));
+    if (item == nullptr || PyList_Append(out, item) < 0) {
+      Py_XDECREF(item);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(item);
+  }
+  return out;
+}
+
+// va_new(track_processed, kind) -> capsule
+PyObject* va_new(PyObject*, PyObject* args) {
+  int track, kind;
+  if (!PyArg_ParseTuple(args, "pi", &track, &kind)) return nullptr;
+  auto* agg = new VoteAgg();
+  agg->track_processed = track != 0;
+  agg->kind = static_cast<uint8_t>(kind);
+  return PyCapsule_New(agg, "mysticeti.va", va_destroy);
+}
+
+// va_bind(cap, stakes_list, threshold)
+PyObject* va_bind(PyObject*, PyObject* args) {
+  PyObject* cap;
+  PyObject* stakes;
+  unsigned long long threshold;
+  if (!PyArg_ParseTuple(args, "OOK", &cap, &stakes, &threshold)) return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr) return nullptr;
+  PyObject* seq = PySequence_Fast(stakes, "stakes must be a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (n > kMaskWords * 64) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "committee exceeds 512 authorities");
+    return nullptr;
+  }
+  agg->stakes.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    agg->stakes[static_cast<size_t>(i)] = PyLong_AsUnsignedLongLong(
+        PySequence_Fast_GET_ITEM(seq, i));
+    if (PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  agg->threshold = threshold;
+  agg->bound = true;
+  Py_RETURN_NONE;
+}
+
+// The shared sweep structure of RangeMap.mutate_range (range_map.py:38-80):
+// fragments of existing entries overlapping [start, end) and the gaps
+// between them, visited in offset order.  `OnFrag` returns true to keep the
+// (possibly modified) fragment, false to drop it; `OnGap` returns true to
+// materialize a fresh entry for the gap (initialized by it).
+template <typename OnFrag, typename OnGap>
+void sweep(VaBlock& b, uint64_t start, uint64_t end, OnFrag on_frag,
+           OnGap on_gap) {
+  std::vector<VaEntry> out;
+  out.reserve(b.ranges.size() + 4);
+  uint64_t cursor = start;
+  for (VaEntry& entry : b.ranges) {
+    if (entry.end <= start || entry.start >= end) {
+      out.push_back(entry);
+      continue;
+    }
+    if (entry.start < start) {
+      VaEntry head = entry;
+      head.end = start;
+      out.push_back(head);
+    }
+    uint64_t ov_s = std::max(entry.start, start);
+    uint64_t ov_e = std::min(entry.end, end);
+    if (cursor < ov_s) {
+      VaEntry fresh;
+      if (on_gap(cursor, ov_s, fresh)) {
+        fresh.start = cursor;
+        fresh.end = ov_s;
+        out.push_back(fresh);
+      }
+    }
+    VaEntry frag = entry;  // POD clone — RangeMap clones on split
+    frag.start = ov_s;
+    frag.end = ov_e;
+    if (on_frag(frag)) out.push_back(frag);
+    cursor = ov_e;
+    if (entry.end > end) {
+      VaEntry tail = entry;
+      tail.start = end;
+      out.push_back(tail);
+    }
+  }
+  if (cursor < end) {
+    VaEntry fresh;
+    if (on_gap(cursor, end, fresh)) {
+      fresh.start = cursor;
+      fresh.end = end;
+      out.push_back(fresh);
+    }
+  }
+  std::sort(out.begin(), out.end(),
+            [](const VaEntry& a, const VaEntry& c) { return a.start < c.start; });
+  b.ranges = std::move(out);
+}
+
+bool va_check_author(VoteAgg* agg, unsigned long long author) {
+  if (!agg->bound) {
+    PyErr_SetString(PyExc_RuntimeError, "VoteAggregator not bound to a committee");
+    return false;
+  }
+  if (author >= agg->stakes.size()) {
+    PyErr_SetString(PyExc_ValueError, "authority index out of range");
+    return false;
+  }
+  return true;
+}
+
+// va_register(cap, key, start, end, author) -> [(s, e) violation ranges]
+//
+// committee.py register(): gaps get a fresh aggregator seeded with the
+// author's vote; existing fragments are duplicate-share violations unless
+// every offset is already processed.
+PyObject* va_register(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  Py_ssize_t keylen;
+  unsigned long long start, end, author;
+  if (!PyArg_ParseTuple(args, "Oy#KKK", &cap, &key, &keylen, &start, &end,
+                        &author))
+    return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr || !va_check_author(agg, author)) return nullptr;
+  std::vector<std::pair<uint64_t, uint64_t>> violations;
+  if (start < end) {
+    VaBlock& b = agg->blocks[std::string(key, static_cast<size_t>(keylen))];
+    bool was_empty = b.ranges.empty();
+    sweep(
+        b, start, end,
+        [&](VaEntry& frag) {
+          if (agg->track_processed) {
+            unprocessed_intervals(b, frag.start, frag.end, violations);
+          }
+          return true;  // keep the existing aggregation untouched
+        },
+        [&](uint64_t, uint64_t, VaEntry& fresh) {
+          std::memset(fresh.mask, 0, sizeof(fresh.mask));
+          fresh.mask[author / 64] = 1ULL << (author % 64);
+          fresh.stake = agg->stakes[author];
+          fresh.kind = agg->kind;
+          return true;
+        });
+    if (was_empty && !b.ranges.empty()) agg->pending_count++;
+  }
+  return intervals_to_list(violations);
+}
+
+// va_vote(cap, key, start, end, author)
+//   -> ([(s, e) certified...], [(s, e) violations...], block_retired)
+//
+// committee.py vote(): gaps are unknown-transaction violations unless
+// processed; fragments accumulate the vote and certify at the threshold
+// (certified fragments are dropped and marked processed).  `block_retired`
+// tells the wrapper the block record was dropped entirely (only possible
+// when track_processed is off — with tracking on, the processed intervals
+// must outlive the pending ranges, exactly like the pure path's `processed`
+// set).
+PyObject* va_vote(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  Py_ssize_t keylen;
+  unsigned long long start, end, author;
+  if (!PyArg_ParseTuple(args, "Oy#KKK", &cap, &key, &keylen, &start, &end,
+                        &author))
+    return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr || !va_check_author(agg, author)) return nullptr;
+  std::vector<std::pair<uint64_t, uint64_t>> done;
+  std::vector<std::pair<uint64_t, uint64_t>> violations;
+  bool retired = false;
+  if (start < end) {
+    auto found = agg->blocks.find(std::string(key, static_cast<size_t>(keylen)));
+    if (found == agg->blocks.end()) {
+      // No record for this block at all: nothing pending and nothing ever
+      // processed (committee.py vote():380-384).
+      if (agg->track_processed) violations.emplace_back(start, end);
+    } else {
+      VaBlock& b = found->second;
+      bool was_nonempty = !b.ranges.empty();
+      sweep(
+          b, start, end,
+          [&](VaEntry& frag) {
+            uint64_t bit = 1ULL << (author % 64);
+            if (!(frag.mask[author / 64] & bit)) {
+              frag.mask[author / 64] |= bit;
+              frag.stake += agg->stakes[author];
+            }
+            if (frag.stake >= agg->threshold) {
+              done.emplace_back(frag.start, frag.end);
+              return false;  // certified: drop from pending
+            }
+            return true;
+          },
+          [&](uint64_t gs, uint64_t ge, VaEntry&) {
+            if (agg->track_processed) unprocessed_intervals(b, gs, ge, violations);
+            return false;  // gaps stay gaps
+          });
+      if (agg->track_processed) {
+        for (auto& range : done) processed_mark(b, range.first, range.second);
+      }
+      if (was_nonempty && b.ranges.empty()) {
+        agg->pending_count--;
+        if (!agg->track_processed) {
+          // Nothing left to remember for this block: drop the record so a
+          // long-running certified-log node (track_processed off) stays
+          // flat on memory, like the pure path deleting its RangeMap.
+          agg->blocks.erase(found);
+          retired = true;
+        }
+      }
+    }
+  }
+  PyObject* certified = intervals_to_list(done);
+  if (certified == nullptr) return nullptr;
+  PyObject* viol = intervals_to_list(violations);
+  if (viol == nullptr) {
+    Py_DECREF(certified);
+    return nullptr;
+  }
+  PyObject* out = Py_BuildValue("(NNO)", certified, viol,
+                                retired ? Py_True : Py_False);
+  if (out == nullptr) {
+    Py_DECREF(certified);
+    Py_DECREF(viol);
+  }
+  return out;
+}
+
+// va_is_processed(cap, key, offset) -> bool
+PyObject* va_is_processed(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  Py_ssize_t keylen;
+  unsigned long long off;
+  if (!PyArg_ParseTuple(args, "Oy#K", &cap, &key, &keylen, &off)) return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr) return nullptr;
+  auto found = agg->blocks.find(std::string(key, static_cast<size_t>(keylen)));
+  if (found == agg->blocks.end()) Py_RETURN_FALSE;
+  if (processed_contains(found->second, off)) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+// va_pending_len(cap) -> number of blocks with live aggregations
+PyObject* va_pending_len(PyObject*, PyObject* args) {
+  PyObject* cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr) return nullptr;
+  return PyLong_FromSize_t(agg->pending_count);
+}
+
+// va_items(cap) -> [(key, [(start, end, stake, kind, mask_bytes)...])...]
+// for blocks with live ranges (state snapshot source; caller sorts by ref).
+PyObject* va_items(PyObject*, PyObject* args) {
+  PyObject* cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr) return nullptr;
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) return nullptr;
+  for (auto& kv : agg->blocks) {
+    if (kv.second.ranges.empty()) continue;
+    PyObject* ranges = PyList_New(0);
+    if (ranges == nullptr) goto fail;
+    for (const VaEntry& e : kv.second.ranges) {
+      PyObject* item = Py_BuildValue(
+          "(KKKiy#)", static_cast<unsigned long long>(e.start),
+          static_cast<unsigned long long>(e.end),
+          static_cast<unsigned long long>(e.stake), static_cast<int>(e.kind),
+          reinterpret_cast<const char*>(e.mask),
+          static_cast<Py_ssize_t>(sizeof(e.mask)));
+      if (item == nullptr || PyList_Append(ranges, item) < 0) {
+        Py_XDECREF(item);
+        Py_DECREF(ranges);
+        goto fail;
+      }
+      Py_DECREF(item);
+    }
+    {
+      PyObject* pair = Py_BuildValue(
+          "(y#N)", kv.first.data(), static_cast<Py_ssize_t>(kv.first.size()),
+          ranges);
+      if (pair == nullptr) {
+        Py_DECREF(ranges);
+        goto fail;
+      }
+      if (PyList_Append(out, pair) < 0) {
+        Py_DECREF(pair);
+        goto fail;
+      }
+      Py_DECREF(pair);
+    }
+  }
+  return out;
+fail:
+  Py_DECREF(out);
+  return nullptr;
+}
+
+// va_load(cap, key, start, end, stake, kind, mask_bytes) — state restore.
+PyObject* va_load(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  Py_ssize_t keylen;
+  unsigned long long start, end, stake;
+  int kind;
+  const char* mask;
+  Py_ssize_t masklen;
+  if (!PyArg_ParseTuple(args, "Oy#KKKiy#", &cap, &key, &keylen, &start, &end,
+                        &stake, &kind, &mask, &masklen))
+    return nullptr;
+  VoteAgg* agg = va_from(cap);
+  if (agg == nullptr) return nullptr;
+  if (masklen > static_cast<Py_ssize_t>(sizeof(uint64_t) * kMaskWords)) {
+    PyErr_SetString(PyExc_ValueError, "vote mask too wide");
+    return nullptr;
+  }
+  VaBlock& b = agg->blocks[std::string(key, static_cast<size_t>(keylen))];
+  bool was_empty = b.ranges.empty();
+  VaEntry e;
+  e.start = start;
+  e.end = end;
+  e.stake = stake;
+  e.kind = static_cast<uint8_t>(kind);
+  std::memset(e.mask, 0, sizeof(e.mask));
+  std::memcpy(e.mask, mask, static_cast<size_t>(masklen));
+  auto pos = std::upper_bound(
+      b.ranges.begin(), b.ranges.end(), e,
+      [](const VaEntry& a, const VaEntry& c) { return a.start < c.start; });
+  b.ranges.insert(pos, e);
+  if (was_empty) agg->pending_count++;
+  Py_RETURN_NONE;
+}
+
 PyMethodDef kMethods[] = {
     {"wal_scan", wal_scan, METH_VARARGS,
      "Scan crc-framed WAL entries; returns (pos, tag, off, len) tuples."},
     {"frame_entry", frame_entry, METH_VARARGS,
      "Assemble one framed WAL entry (header + parts) with single-pass crc."},
+    {"va_new", va_new, METH_VARARGS, "New vote-aggregator core."},
+    {"va_bind", va_bind, METH_VARARGS, "Bind committee stakes + threshold."},
+    {"va_register", va_register, METH_VARARGS,
+     "Register a shared range with the author's self-vote."},
+    {"va_vote", va_vote, METH_VARARGS,
+     "Tally a vote range; returns (certified ranges, violation offset)."},
+    {"va_is_processed", va_is_processed, METH_VARARGS,
+     "Was this (block, offset) certified?"},
+    {"va_pending_len", va_pending_len, METH_VARARGS,
+     "Number of blocks with pending aggregations."},
+    {"va_items", va_items, METH_VARARGS, "Snapshot pending ranges."},
+    {"va_load", va_load, METH_VARARGS, "Restore one pending range."},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -19,11 +19,17 @@ reference's layering trick (crypto.rs:77-84) that batch verification relies on.
 """
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from . import crypto
 from .serde import Reader, SerdeError, Writer
+
+# Structs for the inline block decoder (from_bytes fast path).
+_U64X2 = struct.Struct("<QQ")
+_U64_AT = struct.Struct("<Q")
+_U32_AT = struct.Struct("<I")
 
 AuthorityIndex = int  # u64 in encodings
 RoundNumber = int
@@ -396,21 +402,106 @@ class StatementBlock:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "StatementBlock":
-        r = Reader(data)
-        authority = r.u64()
-        round_ = r.u64()
-        includes = tuple(BlockReference.decode(r) for _ in range(r.u32()))
-        statements = tuple(decode_statement(r) for _ in range(r.u32()))
-        meta_ns = r.u64()
-        epoch_marker = r.u8()
-        epoch = r.u64()
-        signature = r.fixed(crypto.SIGNATURE_SIZE)
-        r.expect_done()
+        """Single-pass inline decoder.
+
+        Wire format identical to the Reader-based encoders above; the
+        per-field Reader method calls dominated the receive-path profile at
+        load (millions of ``_take`` calls), so this path unpacks with local
+        offsets.  Error semantics match: any truncation, bad tag, invalid
+        vote byte, or trailing garbage raises SerdeError."""
+        try:
+            n = len(data)
+            authority, round_ = _U64X2.unpack_from(data, 0)
+            pos = 16
+            (cnt,) = _U32_AT.unpack_from(data, pos)
+            pos += 4
+            includes = []
+            for _ in range(cnt):
+                a, rr = _U64X2.unpack_from(data, pos)
+                digest = bytes(data[pos + 16 : pos + 48])
+                if len(digest) != crypto.DIGEST_SIZE:
+                    raise SerdeError("truncated input: include digest")
+                includes.append(BlockReference(a, rr, digest))
+                pos += 48
+            (cnt,) = _U32_AT.unpack_from(data, pos)
+            pos += 4
+            statements = []
+            for _ in range(cnt):
+                tag = data[pos]
+                pos += 1
+                if tag == _ST_SHARE:
+                    (ln,) = _U32_AT.unpack_from(data, pos)
+                    pos += 4
+                    end = pos + ln
+                    if end > n:
+                        raise SerdeError("truncated input: share payload")
+                    statements.append(Share(bytes(data[pos:end])))
+                    pos = end
+                elif tag == _ST_VOTE:
+                    a, rr = _U64X2.unpack_from(data, pos)
+                    digest = bytes(data[pos + 16 : pos + 48])
+                    if len(digest) != crypto.DIGEST_SIZE:
+                        raise SerdeError("truncated input: vote digest")
+                    (off,) = _U64_AT.unpack_from(data, pos + 48)
+                    locator = TransactionLocator(BlockReference(a, rr, digest), off)
+                    pos += 56
+                    vote_byte = data[pos]
+                    pos += 1
+                    if vote_byte not in (VOTE_ACCEPT, VOTE_REJECT):
+                        raise SerdeError(f"invalid vote byte {vote_byte}")
+                    accept = vote_byte == VOTE_ACCEPT
+                    conflict = None
+                    if not accept:
+                        presence = data[pos]
+                        pos += 1
+                        if presence not in (0, 1):
+                            raise SerdeError(
+                                f"invalid conflict-presence byte {presence}"
+                            )
+                        if presence == 1:
+                            a2, rr2 = _U64X2.unpack_from(data, pos)
+                            digest2 = bytes(data[pos + 16 : pos + 48])
+                            if len(digest2) != crypto.DIGEST_SIZE:
+                                raise SerdeError("truncated input: conflict")
+                            (off2,) = _U64_AT.unpack_from(data, pos + 48)
+                            conflict = TransactionLocator(
+                                BlockReference(a2, rr2, digest2), off2
+                            )
+                            pos += 56
+                    statements.append(Vote(locator, accept, conflict))
+                elif tag == _ST_VOTE_RANGE:
+                    a, rr = _U64X2.unpack_from(data, pos)
+                    digest = bytes(data[pos + 16 : pos + 48])
+                    if len(digest) != crypto.DIGEST_SIZE:
+                        raise SerdeError("truncated input: range digest")
+                    s, e = _U64X2.unpack_from(data, pos + 48)
+                    rng = TransactionLocatorRange(BlockReference(a, rr, digest), s, e)
+                    rng.verify()
+                    statements.append(VoteRange(rng))
+                    pos += 64
+                else:
+                    raise SerdeError(f"unknown statement tag {tag}")
+            (meta_ns,) = _U64_AT.unpack_from(data, pos)
+            pos += 8
+            epoch_marker = data[pos]
+            pos += 1
+            (epoch,) = _U64_AT.unpack_from(data, pos)
+            pos += 8
+            signature = bytes(data[pos : pos + crypto.SIGNATURE_SIZE])
+            if len(signature) != crypto.SIGNATURE_SIZE:
+                raise SerdeError("truncated input: signature")
+            pos += crypto.SIGNATURE_SIZE
+            if pos != n:
+                raise SerdeError(f"trailing garbage: {n - pos} bytes")
+        except struct.error:
+            raise SerdeError("truncated input") from None
+        except IndexError:
+            raise SerdeError("truncated input") from None
         digest = crypto.blake2b_256(data)
         ref = BlockReference(authority, round_, digest)
         return cls(
-            ref, includes, statements, meta_ns, epoch_marker, epoch, signature,
-            _bytes=bytes(data),
+            ref, tuple(includes), tuple(statements), meta_ns, epoch_marker,
+            epoch, signature, _bytes=bytes(data),
         )
 
     # -- accessors --

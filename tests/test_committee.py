@@ -240,3 +240,155 @@ class TestVoteRangeBuilder:
 
     def test_empty(self):
         assert VoteRangeBuilder().finish() is None
+
+
+class TestNativeAggregatorParity:
+    """The C++ VoteAggregator core must be behaviorally identical to the
+    pure-Python RangeMap/StakeAggregator path — same certifications, same
+    violations, byte-identical state snapshots."""
+
+    @staticmethod
+    def _pair():
+        from mysticeti_tpu.native import native
+
+        if native is None or not hasattr(native, "va_new"):
+            import pytest
+
+            pytest.skip("native extension unavailable")
+        nat = TransactionAggregator(QUORUM)
+        assert nat._nat is not None
+        py = TransactionAggregator(QUORUM)
+        py._nat = None  # pin the fallback path
+        return nat, py
+
+    def test_randomized_differential(self):
+        import random
+
+        c = Committee.new_test([1, 2, 1, 1, 2])
+        nat, py = self._pair()
+        rng = random.Random(42)
+        blocks = [_block_with_shares(a % 4, 12) for a in range(3)]
+        for blk in blocks:
+            for agg in (nat, py):
+                agg.process_block(blk, None, c)
+        assert len(nat) == len(py)
+        for _ in range(200):
+            blk = rng.choice(blocks)
+            s = rng.randrange(0, 12)
+            e = rng.randrange(s + 1, 13)
+            voter = rng.randrange(5)
+            locator_range = TransactionLocatorRange(blk.reference, s, e)
+            out_n, out_p = [], []
+            err_n = err_p = None
+            try:
+                nat.vote(locator_range, voter, c, out_n)
+            except RuntimeError as exc:
+                err_n = str(exc)
+            try:
+                py.vote(locator_range, voter, c, out_p)
+            except RuntimeError as exc:
+                err_p = str(exc)
+            assert out_n == out_p
+            assert (err_n is None) == (err_p is None), (err_n, err_p)
+            if err_n is not None:
+                assert err_n == err_p
+            assert len(nat) == len(py)
+            assert nat.state() == py.state()
+        # spot-check processed queries agree
+        for blk in blocks:
+            for off in range(12):
+                k = TransactionLocator(blk.reference, off)
+                assert nat.is_processed(k) == py.is_processed(k)
+
+    def test_duplicate_share_differential(self):
+        c = Committee.new_test([1, 1, 1, 1])
+        nat, py = self._pair()
+        blk = _block_with_shares(0, 4)
+        for agg in (nat, py):
+            agg.process_block(blk, None, c)
+            try:
+                agg.process_block(blk, None, c)
+                raised = False
+            except RuntimeError:
+                raised = True
+            assert raised, type(agg)
+
+    def test_state_roundtrip_cross_implementation(self):
+        """A native snapshot restores into the python path and vice versa."""
+        c = Committee.new_test([1, 1, 1, 1])
+        nat, py = self._pair()
+        blk = _block_with_shares(0, 8)
+        for agg in (nat, py):
+            agg.process_block(blk, None, c)
+            agg.vote(TransactionLocatorRange(blk.reference, 0, 5), 1, c, [])
+        snap_nat, snap_py = nat.state(), py.state()
+        assert snap_nat == snap_py
+
+        nat2, py2 = self._pair()
+        nat2.with_state(snap_py)  # python snapshot -> native core
+        py2.with_state(snap_nat)  # native snapshot -> python core
+        out_n, out_p = [], []
+        nat2.vote(TransactionLocatorRange(blk.reference, 0, 8), 2, c, out_n)
+        py2.vote(TransactionLocatorRange(blk.reference, 0, 8), 2, c, out_p)
+        assert out_n == out_p
+        assert sorted(k.offset for k in out_n) == [0, 1, 2, 3, 4]
+        assert nat2.state() == py2.state()
+
+    def test_hook_call_count_parity(self):
+        """Non-raising handler hooks must observe every violating offset,
+        native and pure alike (the ProcessedTransactionHandler seam)."""
+
+        class Recording(TransactionAggregator):
+            def __init__(self):
+                super().__init__(QUORUM)
+                self.dups = []
+                self.unknowns = []
+
+            def duplicate_transaction(self, k, from_):
+                self.dups.append(k.offset)
+
+            def unknown_transaction(self, k, from_):
+                self.unknowns.append(k.offset)
+
+        c = Committee.new_test([1, 1, 1, 1])
+        blk = _block_with_shares(0, 6)
+        ref = blk.reference
+        results = []
+        for force_py in (False, True):
+            agg = Recording()
+            if force_py:
+                agg._nat = None
+            elif agg._nat is None:
+                import pytest
+
+                pytest.skip("native extension unavailable")
+            agg.process_block(blk, None, c)
+            # duplicate share over [2, 5) -> 3 duplicate hook calls
+            agg.register(TransactionLocatorRange(ref, 2, 5), 1, c)
+            # vote over a block never shared -> unknown per offset
+            ghost = _block_with_shares(1, 1).reference
+            agg.vote(TransactionLocatorRange(ghost, 0, 4), 2, c, [])
+            results.append((agg.dups, agg.unknowns))
+        assert results[0] == results[1] == ([2, 3, 4], [0, 1, 2, 3])
+
+    def test_untracked_blocks_retire(self):
+        """With track_processed off (certified-log mode) a fully-certified
+        block must release all native state — flat memory at load."""
+        from mysticeti_tpu.native import native
+
+        if native is None or not hasattr(native, "va_new"):
+            import pytest
+
+            pytest.skip("native extension unavailable")
+        c = Committee.new_test([1, 1, 1, 1])
+        agg = TransactionAggregator(QUORUM, track_processed=False)
+        assert agg._nat is not None
+        blk = _block_with_shares(0, 4)
+        agg.process_block(blk, None, c)
+        assert len(agg._refs) == 1
+        out = []
+        rng = TransactionLocatorRange(blk.reference, 0, 4)
+        agg.vote(rng, 1, c, out)
+        agg.vote(rng, 2, c, out)
+        assert len(out) == 4 and agg.is_empty()
+        assert agg._refs == {}  # record retired, no growth

@@ -177,10 +177,37 @@ def test_benchmark_duration_starts_at_first_commit(tmp_path, monkeypatch):
     # 300 s of warmup pass with no commits: duration must stay 0.
     t[0] += 300.0
     tx = struct.pack("<d", 0.0) + b"\0" * 24
-    observer._update_metrics(tx, now=0.0)
+    observer._update_metrics_batch([tx], now=0.0)
     assert metrics.benchmark_duration._value.get() == 0.0
 
     # 20 s into the loaded phase the counter reflects loaded time only.
     t[0] += 20.0
-    observer._update_metrics(tx, now=0.0)
+    observer._update_metrics_batch([tx], now=0.0)
     assert metrics.benchmark_duration._value.get() == 20.0
+
+
+def test_observe_latency_batch_matches_per_sample():
+    """The vectorized histogram path must expose byte-identical series to
+    per-sample observe() — the orchestrator's scraper parses this text."""
+    import numpy as np
+
+    from mysticeti_tpu.metrics import Metrics
+
+    samples = [0.0, 0.05, 0.1, 0.100001, 0.9, 1.0, 2.0, 42.0, 89.9, 90.0, 1e4]
+    batched, plain = Metrics(), Metrics()
+    batched.observe_latency_batch("shared", np.array(samples))
+    for v in samples:
+        plain.latency_s.labels("shared").observe(v)
+        plain.latency_squared_s.labels("shared").inc(v**2)
+
+    def series(m, needle):
+        return sorted(
+            line
+            for line in m.expose().decode().splitlines()
+            if line.startswith(needle) and "_created" not in line
+        )
+
+    assert series(batched, "latency_s") == series(plain, "latency_s")
+    assert series(batched, "latency_squared_s") == series(
+        plain, "latency_squared_s"
+    )

@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Max-sustainable-load search over a local fleet, per verifier backend.
+
+Reproducible generator of the MAXLOAD artifacts: runs the orchestrator's
+binary search (benchmark.rs:202-271 semantics — double until out-of-capacity,
+then bisect; out-of-capacity = avg latency > 5x previous or tps < 2/3
+offered) with the chosen --verifier and records every probe.
+
+Usage:
+  python tools/maxload_bench.py --verifier cpu --out MAXLOAD_r03.json
+  python tools/maxload_bench.py --verifiers cpu tpu --out MAXLOAD_TPU_r03.json
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def search_one(verifier: str, nodes: int, start_load: int,
+                     duration: float, iterations: int, workdir: str) -> dict:
+    from mysticeti_tpu.orchestrator.benchmark import LoadType, ParametersGenerator
+    from mysticeti_tpu.orchestrator.orchestrator import Orchestrator
+    from mysticeti_tpu.orchestrator.runner import LocalProcessRunner
+
+    if verifier.startswith("tpu"):
+        os.environ["INITIAL_DELAY"] = "10"
+        duration = max(duration, 60.0)
+    else:
+        os.environ.pop("INITIAL_DELAY", None)
+    runner = LocalProcessRunner(
+        os.path.join(workdir, f"fleet-{verifier}"), verifier=verifier
+    )
+    generator = ParametersGenerator(
+        nodes,
+        LoadType.search(start_load, max_iterations=iterations),
+        duration_s=duration,
+    )
+    orch = Orchestrator(
+        runner,
+        generator,
+        results_dir=os.path.join(workdir, f"results-{verifier}"),
+        scrape_interval_s=duration / 3,
+    )
+    collections = await orch.run_benchmarks()
+    probes = []
+    peak = 0.0
+    for c in collections:
+        tps = c.aggregate_tps()
+        peak = max(peak, tps)
+        probes.append(
+            {
+                "offered_load_tx_s": c.parameters["load"],
+                "tps": round(tps, 1),
+                "avg_latency_s": round(c.aggregate_average_latency_s(), 4),
+                "stdev_latency_s": round(c.aggregate_stdev_latency_s(), 4),
+            }
+        )
+    return {
+        "verifier": verifier,
+        "nodes": nodes,
+        "max_sustainable_load_tx_s": generator.max_sustainable_load(),
+        "peak_committed_tx_s": round(peak, 1),
+        "probes": probes,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--start-load", type=int, default=400)
+    parser.add_argument("--duration", type=float, default=30.0)
+    parser.add_argument("--iterations", type=int, default=7)
+    parser.add_argument("--workdir", default="/tmp/mysticeti-maxload")
+    parser.add_argument("--out", default="MAXLOAD.json")
+    parser.add_argument(
+        "--verifiers", nargs="+", default=["cpu"],
+        choices=["accept", "cpu", "tpu", "tpu-only"],
+    )
+    args = parser.parse_args()
+
+    runs = []
+    for verifier in args.verifiers:
+        print(f"max-load search verifier={verifier}...", flush=True)
+        run = asyncio.run(
+            search_one(verifier, args.nodes, args.start_load, args.duration,
+                       args.iterations, args.workdir)
+        )
+        runs.append(run)
+        print(json.dumps(run), flush=True)
+
+    artifact = {
+        "metric": "max_sustainable_load_tx_s",
+        "host": "single-core CI box (all validators + load generators share one core)",
+        "search_rule": (
+            "double until out-of-capacity (latency>5x prev or tps<2/3 "
+            "offered), then bisect (benchmark.rs:202-271 semantics)"
+        ),
+        "runs": runs,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
