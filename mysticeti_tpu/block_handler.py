@@ -32,7 +32,14 @@ from .types import (
     TransactionLocator,
 )
 
-SOFT_MAX_PROPOSED_PER_BLOCK = 10 * 1000
+# Proposal drain cap (block_handler.rs SOFT_MAX equivalent).  Env-tunable:
+# shrinking it raises the block rate at a given load, which reproduces the
+# per-node block-arrival (and therefore signature-verification) rate of a
+# large WAN committee on a small local fleet — the verification-bound regime
+# of BASELINE configs #4/#5.
+SOFT_MAX_PROPOSED_PER_BLOCK = int(
+    os.environ.get("MYSTICETI_MAX_BLOCK_TX", str(10 * 1000))
+)
 MAX_PROPOSED_PER_BLOCK = 10000
 
 

@@ -119,9 +119,17 @@ class TpuSignatureVerifier(SignatureVerifier):
     def warmup(self) -> None:
         """Trace + compile (or load from the persistent cache) the smallest
         bucket kernel so the first real block batch is not stalled ~15-30 s
-        behind JAX tracing."""
+        behind JAX tracing.  Warms BOTH dispatch flavors: a single-unknown-key
+        batch (groups trivially -> keyed-tile kernel) and, when a committee
+        table is present, a one-sig-per-committee-key batch (grouping
+        overflows the smallest bucket -> generic ladder fallback)."""
         dummy = bytes(32)
         self.verify_signatures([dummy], [dummy], [bytes(64)])
+        if self._table is not None and len(self._table) > 1:
+            pks = list(self._table._keys)
+            self.verify_signatures(
+                pks, [dummy] * len(pks), [bytes(64)] * len(pks)
+            )
 
     def verify_signatures(self, public_keys, digests, signatures):
         mesh = self._resolve_mesh()

@@ -185,6 +185,11 @@ class NetworkSyncer:
                 asyncio.ensure_future(self._connection_task(connection))
             )
 
+    # Max verification groups in flight per connection: deep enough that a
+    # remote accelerator's per-dispatch round-trip (~100-300 ms tunneled)
+    # overlaps many batches, small enough to backpressure a flooding peer.
+    VERIFY_PIPELINE_DEPTH = 32
+
     async def _connection_task(self, connection: Connection) -> None:
         """net_sync.rs:237-312."""
         peer = connection.peer
@@ -202,6 +207,21 @@ class NetworkSyncer:
         # Ask the peer for its own blocks we have not yet seen.
         last_seen = self.core.block_store.last_seen_by_authority(peer)
         await connection.send(SubscribeOwnFrom(last_seen))
+        # Per-connection verification pipeline: the reader overlaps many
+        # in-flight signature batches (the accelerator's round-trip would
+        # otherwise serialize the connection at one batch per RTT), while the
+        # accept loop awaits results IN ORDER so blocks enter the core in the
+        # stream order the peer sent them (no spurious missing-parent
+        # requests).
+        pipeline: asyncio.Queue = asyncio.Queue(maxsize=self.VERIFY_PIPELINE_DEPTH)
+        # Same-connection dedup window: dispatcher.processed only knows blocks
+        # that finished the pipeline, so without this a peer retransmitting a
+        # block back-to-back would get every copy signature-verified while the
+        # first is still in flight.
+        inflight: Set[bytes] = set()
+        accept_task = asyncio.ensure_future(
+            self._accept_ordered(pipeline, connection, inflight)
+        )
         try:
             while True:
                 msg = await connection.recv()
@@ -209,12 +229,25 @@ class NetworkSyncer:
                     break
                 if isinstance(msg, SubscribeOwnFrom):
                     disseminator.subscribe_own_from(msg.round)
-                elif isinstance(msg, Blocks):
-                    await self._process_blocks(msg.blocks, connection)
+                elif isinstance(msg, (Blocks, RequestBlocksResponse)):
+                    verified = await self._decode_fresh(msg.blocks)
+                    verified = [
+                        b for b in verified
+                        if b.reference.digest not in inflight
+                    ]
+                    if verified:
+                        refs = [b.reference.digest for b in verified]
+                        inflight.update(refs)
+                        fut = asyncio.ensure_future(
+                            self._verify_accepted(verified)
+                        )
+                        try:
+                            await pipeline.put((fut, refs))
+                        except asyncio.CancelledError:
+                            fut.cancel()
+                            raise
                 elif isinstance(msg, RequestBlocks):
                     await disseminator.send_requested(list(msg.references))
-                elif isinstance(msg, RequestBlocksResponse):
-                    await self._process_blocks(msg.blocks, connection)
                 elif isinstance(msg, BlockNotFound):
                     if self.metrics is not None:
                         self.metrics.block_sync_requests_failed.inc(
@@ -222,15 +255,70 @@ class NetworkSyncer:
                         )
         finally:
             log.debug("connection to authority %d closed", peer)
+            # Drain what already entered the pipeline, then stop the acceptor.
+            # If this task is itself being cancelled (node stop), don't wait —
+            # cancel the acceptor instead of hanging in the finally.
+            try:
+                await pipeline.put(None)
+                await accept_task
+            except asyncio.CancelledError:
+                accept_task.cancel()
+                try:
+                    await accept_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            # Cancel any verify futures still queued (nothing will await
+            # them once the acceptor is gone).
+            while True:
+                try:
+                    item = pipeline.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if item is not None:
+                    item[0].cancel()
             disseminator.stop()
             self._disseminators.pop(peer, None)
             if self.connections.get(peer) is connection:
                 del self.connections[peer]
             connection.close()
 
+    async def _accept_ordered(
+        self, pipeline: asyncio.Queue, connection, inflight: Set[bytes]
+    ) -> None:
+        while True:
+            item = await pipeline.get()
+            if item is None:
+                return
+            fut, refs = item
+            try:
+                accepted = await fut
+                if accepted:
+                    await self._add_accepted(accepted, connection)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a bad batch must not kill the pipe
+                log.exception("accept pipeline stage failed")
+            finally:
+                for ref in refs:
+                    inflight.discard(ref)
+
     # -- the receive pipeline (net_sync.rs:314-386) --
 
     async def _process_blocks(self, serialized_blocks, origin=None) -> None:
+        """Single-shot decode+verify+add (the pipelined connection path goes
+        through the same stages; this entry remains for tests and callers
+        outside a connection task)."""
+        verified = await self._decode_fresh(serialized_blocks)
+        if not verified:
+            return
+        accepted = await self._verify_accepted(verified)
+        if not accepted:
+            return
+        await self._add_accepted(accepted, origin)
+
+    async def _decode_fresh(self, serialized_blocks) -> List[StatementBlock]:
+        """Stage 1 (host, fast): parse, dedup via the core task, consensus-
+        rule checks."""
         blocks: List[StatementBlock] = []
         for raw in serialized_blocks:
             try:
@@ -240,7 +328,7 @@ class NetworkSyncer:
                 continue  # malformed: drop (byzantine peer)
             blocks.append(block)
         if not blocks:
-            return
+            return []
         # Dedup through the core task before paying for verification.
         processed = await self.dispatcher.processed([b.reference for b in blocks])
         fresh = [b for b, done in zip(blocks, processed) if not done]
@@ -252,10 +340,13 @@ class NetworkSyncer:
                 log.warning("rejecting block %r: %s", block.reference, exc)
                 continue
             verified.append(block)
-        if not verified:
-            return
-        # Signature + application check through the pluggable verifier
-        # (batched across connections on TPU).
+        return verified
+
+    async def _verify_accepted(
+        self, verified: List[StatementBlock]
+    ) -> List[StatementBlock]:
+        """Stage 2 (accelerator): signature + application check through the
+        pluggable verifier (batched across connections on TPU)."""
         results = await self.block_verifier.verify_blocks(verified)
         accepted = [b for b, ok in zip(verified, results) if ok]
         if len(accepted) < len(verified):
@@ -264,8 +355,10 @@ class NetworkSyncer:
                 len(verified) - len(accepted),
                 len(verified),
             )
-        if not accepted:
-            return
+        return accepted
+
+    async def _add_accepted(self, accepted: List[StatementBlock], origin) -> None:
+        """Stage 3: hand to the core, chase missing causal history."""
         missing = await self.dispatcher.add_blocks(
             accepted, self.connected_authorities.copy()
         )

@@ -55,7 +55,17 @@ def _cst(x: int) -> np.ndarray:
 # Pallas kernels cannot close over array constants — the six field constants
 # (+ a zero plane) are passed as one (7, NLIMBS, tile) input (_consts_wide)
 # and re-bound to this namespace at kernel trace time (_bind_consts).
-class _ConstNS:
+#
+# THREAD-LOCAL: kernel flavors trace concurrently in a validator (the
+# verifier warmup thread compiles one kernel while a peer batch traces
+# another on an executor thread); a shared namespace lets one trace read the
+# other's bindings mid-trace, which surfaces as a "captures constants"
+# pallas error (or silently wrong constants).  Each tracing thread gets its
+# own bindings.
+import threading as _threading
+
+
+class _ConstNS(_threading.local):
     one: jnp.ndarray
     bias_8p: jnp.ndarray
     p_limbs: jnp.ndarray
