@@ -31,6 +31,9 @@ from .crypto import Signer
 from .validator import Validator
 
 
+VERIFIER_CHOICES = ["accept", "cpu", "tpu", "tpu-only", "cpu-agg", "tpu-agg"]
+
+
 def _benchmark_parameters(ips: List[str]) -> Parameters:
     return Parameters.new_for_benchmarks(ips)
 
@@ -139,19 +142,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     r.add_argument("--committee-path", required=True)
     r.add_argument("--parameters-path", required=True)
     r.add_argument("--private-config-path", required=True)
-    r.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
+    r.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
 
     d = sub.add_parser("dry-run", help="one validator of an N-node local setup")
     d.add_argument("--committee-size", type=int, required=True)
     d.add_argument("--authority", type=int, required=True)
     d.add_argument("--working-directory", default="dryrun")
-    d.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
+    d.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
 
     t = sub.add_parser("testbed", help="N in-process validators")
     t.add_argument("--committee-size", type=int, required=True)
     t.add_argument("--working-directory", default="testbed")
     t.add_argument("--duration", type=float, default=30.0)
-    t.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
+    t.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
 
     o = sub.add_parser(
         "orchestrator",
@@ -171,7 +174,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     o.add_argument("--fault-kind", choices=["none", "permanent", "crash-recovery"],
                    default="none")
     o.add_argument("--fault-interval", type=float, default=30.0)
-    o.add_argument("--verifier", choices=["accept", "cpu", "tpu", "tpu-only"], default="cpu")
+    o.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
     o.add_argument("--tps-per-node", type=int, default=None,
                    help="override the generator load split (default: load/nodes)")
     o.add_argument("--working-directory", default="benchmark-fleet")

@@ -51,6 +51,7 @@ from .types import (
     AuthoritySet,
     BlockReference,
     RoundNumber,
+    Share,
     StatementBlock,
 )
 from .wal import POSITION_MAX, WalPosition, WalSyncer, WalWriter
@@ -201,6 +202,20 @@ class Core:
             else:
                 if not self.epoch_changing():
                     statements.extend(meta.statements)
+        # Group shares into ONE contiguous run (relative order preserved on
+        # both sides).  Every share RUN costs every observer a VoteRange
+        # statement in its next block (committee.shared_ranges): when handler
+        # calls interleave shares with votes across payload entries, the runs
+        # fragment and per-block vote statements blow up to O(committee²) in
+        # vote-heavy workloads — measured 360 VoteRanges/block at 20
+        # authorities vs 19 with grouping.  Offsets inside the proposal are
+        # assigned after this reordering, so locators stay self-consistent.
+        if statements:
+            shares = [s for s in statements if isinstance(s, Share)]
+            if shares:
+                statements = shares + [
+                    s for s in statements if not isinstance(s, Share)
+                ]
 
         assert includes
         from .runtime import timestamp_utc

@@ -302,19 +302,7 @@ class NetworkSyncer:
                 for ref in refs:
                     inflight.discard(ref)
 
-    # -- the receive pipeline (net_sync.rs:314-386) --
-
-    async def _process_blocks(self, serialized_blocks, origin=None) -> None:
-        """Single-shot decode+verify+add (the pipelined connection path goes
-        through the same stages; this entry remains for tests and callers
-        outside a connection task)."""
-        verified = await self._decode_fresh(serialized_blocks)
-        if not verified:
-            return
-        accepted = await self._verify_accepted(verified)
-        if not accepted:
-            return
-        await self._add_accepted(accepted, origin)
+    # -- the receive pipeline (net_sync.rs:314-386), three stages --
 
     async def _decode_fresh(self, serialized_blocks) -> List[StatementBlock]:
         """Stage 1 (host, fast): parse, dedup via the core task, consensus-

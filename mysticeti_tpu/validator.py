@@ -29,6 +29,7 @@ from .block_validator import (
     BatchedSignatureVerifier,
     CpuSignatureVerifier,
     HybridSignatureVerifier,
+    ThresholdAggregateVerifier,
     TpuSignatureVerifier,
 )
 from .commit_observer import SimpleCommitObserver, TestCommitObserver
@@ -68,6 +69,9 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
     import threading
 
     ready = threading.Event()
+    aggregate = kind.endswith("-agg")
+    if aggregate:
+        kind = kind[: -len("-agg")]
     if kind in ("tpu", "tpu-only"):
         tpu_backend = TpuSignatureVerifier(
             committee_keys=[
@@ -105,6 +109,11 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
         verifier = AcceptAllBlockVerifier()
     else:
         raise ValueError(f"unknown verifier kind {kind!r}")
+    if aggregate and not isinstance(verifier, AcceptAllBlockVerifier):
+        # "<kind>-agg": threshold-aggregate wrapper (BASELINE #5's named
+        # technique) — quorum-endorsed interior blocks skip the signature
+        # check; the frontier goes through <kind>'s verifier.
+        verifier = ThresholdAggregateVerifier(committee, verifier, metrics)
     verifier.ready = ready
     return verifier
 

@@ -1,0 +1,231 @@
+"""Threshold-aggregate verification (BASELINE config #5's technique).
+
+Safety contract: NO forged block is ever accepted unless a quorum (2f+1
+distinct-authority stake — beyond the fault model if all are dishonest) of
+accepted blocks references it; every acceptance chain terminates at directly
+signature-verified frontier blocks.
+"""
+import asyncio
+
+import pytest
+
+from mysticeti_tpu.block_validator import (
+    BatchedSignatureVerifier,
+    CpuSignatureVerifier,
+    ThresholdAggregateVerifier,
+)
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.types import Share, StatementBlock
+
+
+@pytest.fixture
+def setup():
+    signers = Committee.benchmark_signers(4)
+    committee = Committee.new_for_benchmarks(4)
+    return committee, signers
+
+
+class CountingInner(BatchedSignatureVerifier):
+    def __init__(self, committee):
+        super().__init__(
+            committee, CpuSignatureVerifier(), max_batch=64, max_delay_s=0.001
+        )
+        self.seen = []
+
+    async def verify_blocks(self, blocks):
+        self.seen.extend(b.reference for b in blocks)
+        return await super().verify_blocks(blocks)
+
+
+def _dag(signers, rounds, per_round=4, forge=()):
+    """Rounds of fully-connected blocks; ``forge`` = set of (round, authority)
+    whose signature bytes are corrupted after signing."""
+    genesis = [StatementBlock.new_genesis(a) for a in range(per_round)]
+    prev = [g.reference for g in genesis]
+    out = []
+    for r in range(1, rounds + 1):
+        layer = []
+        for a in range(per_round):
+            blk = StatementBlock.build(
+                a, r, prev, [Share(bytes([r, a]))], signer=signers[a]
+            )
+            if (r, a) in forge:
+                bad = bytes([blk.signature[0] ^ 1]) + blk.signature[1:]
+                blk = StatementBlock(
+                    blk.reference, blk.includes, blk.statements,
+                    blk.meta_creation_time_ns, blk.epoch_marker, blk.epoch,
+                    bad, _bytes=None,
+                )
+            layer.append(blk)
+        out.extend(layer)
+        prev = [b.reference for b in layer]
+    return out
+
+
+def test_interior_blocks_skip_direct_verification(setup):
+    committee, signers = setup
+
+    async def main():
+        inner = CountingInner(committee)
+        agg = ThresholdAggregateVerifier(committee, inner)
+        blocks = _dag(signers, rounds=5)
+        results = await agg.verify_blocks(blocks)
+        assert all(results)
+        # Only the frontier (last round, no in-batch endorsers) was
+        # signature-verified directly.
+        assert len(inner.seen) == 4
+        assert all(ref.round == 5 for ref in inner.seen)
+        assert agg.aggregated_total == 16
+
+    asyncio.run(main())
+
+
+def test_forged_frontier_rejected(setup):
+    committee, signers = setup
+
+    async def main():
+        agg = ThresholdAggregateVerifier(committee, CountingInner(committee))
+        blocks = _dag(signers, rounds=3, forge={(3, 1)})
+        results = await agg.verify_blocks(blocks)
+        by_ref = dict(zip((b.reference for b in blocks), results))
+        for b in blocks:
+            expected = not (b.round() == 3 and b.author() == 1)
+            assert by_ref[b.reference] == expected, b.reference
+
+    asyncio.run(main())
+
+
+def test_forged_interior_without_quorum_rejected(setup):
+    """A forged block endorsed by fewer than quorum distinct authorities is
+    verified directly and rejected."""
+    committee, signers = setup
+
+    async def main():
+        agg = ThresholdAggregateVerifier(committee, CountingInner(committee))
+        blocks = _dag(signers, rounds=2, forge={(1, 2)})
+        forged_ref = next(
+            b.reference for b in blocks if b.round() == 1 and b.author() == 2
+        )
+        # Strip the forged block's endorsements below quorum: only one
+        # round-2 block keeps it in its includes.
+        filtered = []
+        for b in blocks:
+            if b.round() == 2 and b.author() != 0:
+                b = StatementBlock.build(
+                    b.author(), 2,
+                    [r for r in b.includes if r != forged_ref],
+                    list(b.statements), signer=signers[b.author()],
+                )
+            filtered.append(b)
+        results = await agg.verify_blocks(filtered)
+        by_ref = dict(zip((b.reference for b in filtered), results))
+        assert by_ref[forged_ref] is False
+        assert sum(results) == len(filtered) - 1
+
+    asyncio.run(main())
+
+
+def test_collapsed_endorsement_falls_back_to_direct(setup):
+    """If a block's endorsers fail verification, it must not be rejected
+    outright — it gets its own direct check (valid -> accepted)."""
+    committee, signers = setup
+
+    async def main():
+        inner = CountingInner(committee)
+        agg = ThresholdAggregateVerifier(committee, inner)
+        # Round 1 valid, the ENTIRE round-2 frontier forged.
+        blocks = _dag(signers, rounds=2, forge={(2, a) for a in range(4)})
+        results = await agg.verify_blocks(blocks)
+        by_ref = dict(zip((b.reference for b in blocks), results))
+        for b in blocks:
+            assert by_ref[b.reference] == (b.round() == 1), b.reference
+        # Round-1 blocks went through the direct path (second pass).
+        assert sum(1 for r in inner.seen if r.round == 1) == 4
+
+    asyncio.run(main())
+
+
+def test_singletons_bypass_aggregation(setup):
+    committee, signers = setup
+
+    async def main():
+        inner = CountingInner(committee)
+        agg = ThresholdAggregateVerifier(committee, inner)
+        blk = _dag(signers, rounds=1)[0]
+        assert await agg.verify_blocks([blk]) == [True]
+        assert agg.aggregated_total == 0 and len(inner.seen) == 1
+
+    asyncio.run(main())
+
+
+def test_make_verifier_agg_kinds(monkeypatch):
+    from mysticeti_tpu import block_validator as bv
+    from mysticeti_tpu.validator import _make_verifier
+
+    monkeypatch.setattr(bv.HybridSignatureVerifier, "warmup", lambda self: None)
+    committee = Committee.new_for_benchmarks(4)
+    v = _make_verifier("cpu-agg", committee)
+    assert isinstance(v, bv.ThresholdAggregateVerifier)
+    assert isinstance(v.inner, bv.BatchedSignatureVerifier)
+    v = _make_verifier("tpu-agg", committee)
+    assert isinstance(v, bv.ThresholdAggregateVerifier)
+    assert isinstance(v.inner.verifier, bv.HybridSignatureVerifier)
+
+
+def test_validators_commit_with_aggregate_verifier(tmp_path):
+    """4 localhost validators with the threshold-aggregate wrapper over the
+    CPU oracle still commit, and the finalization-safety oracle's input (the
+    committed sequences) stays consistent."""
+    import socket
+
+    from mysticeti_tpu.config import Identifier, Parameters, PrivateConfig
+    from mysticeti_tpu.committee import Authority
+    from mysticeti_tpu.validator import Validator
+
+    def free_ports(n):
+        socks, ports = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        return ports
+
+    async def main():
+        ports = free_ports(8)
+        identifiers = [
+            Identifier("127.0.0.1", ports[2 * i], ports[2 * i + 1])
+            for i in range(4)
+        ]
+        parameters = Parameters(identifiers=identifiers, leader_timeout_s=0.5)
+        signers = Committee.benchmark_signers(4)
+        committee = Committee([Authority(1, s.public_key) for s in signers])
+        validators = [
+            await Validator.start_benchmarking(
+                i,
+                committee,
+                parameters,
+                PrivateConfig.new_in_dir(i, str(tmp_path / f"v{i}")),
+                signer=signers[i],
+                tps=20,
+                serve_metrics_endpoint=False,
+                verifier="cpu-agg",
+            )
+            for i in range(4)
+        ]
+        try:
+
+            async def poll():
+                while True:
+                    if all(len(v.committed_leaders()) >= 2 for v in validators):
+                        return
+                    await asyncio.sleep(0.2)
+
+            await asyncio.wait_for(poll(), timeout=60)
+        finally:
+            for v in validators:
+                await v.stop()
+
+    asyncio.run(main())
