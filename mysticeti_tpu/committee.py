@@ -313,6 +313,12 @@ class TransactionAggregator:
         self.pending: Dict[BlockReference, RangeMap] = {}
         self.track_processed = track_processed
         self.processed: Set[TransactionLocator] = set()
+        # Set by with_state: the processed set is NOT part of the snapshot
+        # (same as the reference, committee.rs:352-362), so after recovery
+        # votes/shares for pre-snapshot transactions are EXPECTED, not
+        # Byzantine — the duplicate/unknown oracles cannot assert what they
+        # did not persist and go lenient.
+        self.recovered = False
         # Native hot core (native/mysticeti_native.cpp VoteAggregator): the
         # per-offset Python objects (locator tuples, StakeAggregator
         # instances, set hashing) dominate the engine profile at load, so the
@@ -369,11 +375,11 @@ class TransactionAggregator:
             self.processed.add(k)
 
     def duplicate_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
-        if self.track_processed and k not in self.processed:
+        if self.track_processed and not self.recovered and k not in self.processed:
             raise RuntimeError(f"duplicate transaction {k} from {from_}")
 
     def unknown_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
-        if self.track_processed and k not in self.processed:
+        if self.track_processed and not self.recovered and k not in self.processed:
             raise RuntimeError(f"vote for unknown transaction {k} from {from_}")
 
     def is_processed(self, k: TransactionLocator) -> bool:
@@ -581,6 +587,7 @@ class TransactionAggregator:
     def with_state(self, state: bytes) -> None:
         if len(self):
             raise RuntimeError("with_state requires an empty aggregator")
+        self.recovered = True
         r = Reader(state)
         for _ in range(r.u32()):
             block_ref = BlockReference.decode(r)

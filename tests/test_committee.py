@@ -392,3 +392,23 @@ class TestNativeAggregatorParity:
         agg.vote(rng, 2, c, out)
         assert len(out) == 4 and agg.is_empty()
         assert agg._refs == {}  # record retired, no growth
+
+    def test_recovered_aggregator_tolerates_pre_snapshot_votes(self):
+        """After with_state recovery the processed set is gone (it is not in
+        the snapshot, committee.rs:352-362), so votes/shares for pre-snapshot
+        transactions must NOT trip the Byzantine oracles; a fresh aggregator
+        still raises (regression: crash-recovery fleets logged
+        unknown-transaction tracebacks on every reboot)."""
+        c = Committee.new_test([1, 1, 1, 1])
+        nat, py = self._pair()
+        blk = _block_with_shares(0, 4)
+        ghost = TransactionLocatorRange(blk.reference, 0, 4)
+        for agg in (nat, py):
+            with pytest.raises(RuntimeError):
+                agg.vote(ghost, 1, c, [])
+            restored = TransactionAggregator(QUORUM)
+            if agg is py:
+                restored._nat = None
+            restored.with_state(agg.state())
+            restored.vote(ghost, 1, c, [])  # no raise
+            restored.register(ghost, 0, c)  # duplicate-share path, no raise
