@@ -1,0 +1,178 @@
+"""Per-connection verification pipeline (net_sync.py): a slow verifier must
+not serialize the receive path, and duplicate blocks inside the pipeline
+window must not be re-verified."""
+import asyncio
+
+import pytest
+
+from mysticeti_tpu.block_validator import BlockVerifier
+from mysticeti_tpu.committee import Committee
+from mysticeti_tpu.config import Parameters
+from mysticeti_tpu.types import Share, StatementBlock
+
+
+class SlowCountingVerifier(BlockVerifier):
+    """Counts concurrent verify_blocks calls; each takes ``delay_s``."""
+
+    def __init__(self, delay_s: float = 0.05) -> None:
+        self.delay_s = delay_s
+        self.calls = 0
+        self.in_flight = 0
+        self.max_in_flight = 0
+        self.seen_refs = []
+
+    async def verify_blocks(self, blocks):
+        self.calls += 1
+        self.seen_refs.extend(b.reference for b in blocks)
+        self.in_flight += 1
+        self.max_in_flight = max(self.max_in_flight, self.in_flight)
+        await asyncio.sleep(self.delay_s)
+        self.in_flight -= 1
+        return [True] * len(blocks)
+
+
+class FakeConnection:
+    """Minimal Connection surface for _connection_task: scripted recv()."""
+
+    def __init__(self, peer, messages):
+        self.peer = peer
+        self._messages = list(messages)
+        self.sent = []
+
+    async def recv(self):
+        if not self._messages:
+            await asyncio.sleep(0.3)  # then let the task be torn down
+            return None
+        msg = self._messages.pop(0)
+        await asyncio.sleep(0)  # yield so pipeline stages interleave
+        return msg
+
+    async def send(self, msg):
+        self.sent.append(msg)
+
+    def try_send(self, msg):
+        self.sent.append(msg)
+        return True
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def syncer_env(tmp_path):
+    """A NetworkSyncer with a scripted connection, no real network."""
+    import os
+
+    from mysticeti_tpu.block_handler import TestBlockHandler
+    from mysticeti_tpu.block_store import BlockStore
+    from mysticeti_tpu.commit_observer import TestCommitObserver
+    from mysticeti_tpu.core import Core, CoreOptions
+    from mysticeti_tpu.net_sync import NetworkSyncer
+    from mysticeti_tpu.wal import walf
+
+    committee = Committee.new_for_benchmarks(4)
+    signers = Committee.benchmark_signers(4)
+    wal_writer, wal_reader = walf(os.path.join(str(tmp_path), "wal-0"))
+    recovered, observer_recovered = BlockStore.open(
+        0, wal_reader, wal_writer, committee
+    )
+    core = Core(
+        block_handler=TestBlockHandler(0, committee, 0),
+        authority=0,
+        committee=committee,
+        parameters=Parameters(leader_timeout_s=10.0),
+        recovered=recovered,
+        wal_writer=wal_writer,
+        options=CoreOptions.test(),
+        signer=signers[0],
+    )
+    observer = TestCommitObserver(core.block_store, committee)
+
+    class _NoNet:
+        connections = None
+
+        async def stop(self):
+            pass
+
+    def make(verifier):
+        return NetworkSyncer(
+            core, observer, _NoNet(), parameters=Parameters(leader_timeout_s=10.0),
+            block_verifier=verifier,
+        )
+
+    return committee, signers, make
+
+
+def _peer_blocks(signers, rounds):
+    """Valid-DAG layers: 3 authors per round (a quorum of 4), fully
+    connected, so every block passes the threshold-clock structure check."""
+    genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+    prev = [g.reference for g in genesis]
+    out = []
+    for r in range(1, rounds + 1):
+        layer = [
+            StatementBlock.build(
+                a, r, prev, [Share(bytes([r, a]))], signer=signers[a]
+            )
+            for a in range(1, 4)
+        ]
+        out.extend(layer)
+        prev = [b.reference for b in layer]
+    return out
+
+
+def test_pipeline_overlaps_slow_verification(syncer_env):
+    """With a 50 ms verifier, N single-block messages must overlap their
+    verification (serialized would take N*50 ms and max_in_flight == 1)."""
+    from mysticeti_tpu.network import Blocks
+
+    committee, signers, make = syncer_env
+    verifier = SlowCountingVerifier(0.05)
+    ns = make(verifier)
+
+    blocks = _peer_blocks(signers, 3)  # 9 blocks
+    msgs = [Blocks((b.to_bytes(),)) for b in blocks]
+
+    async def main():
+        await ns.start()
+        conn = FakeConnection(1, msgs)
+        task = asyncio.ensure_future(ns._connection_task(conn))
+        await asyncio.sleep(0.2)  # 9 x 50ms serialized would need 450ms
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        await ns.stop()
+
+    asyncio.run(main())
+    assert verifier.calls == 9
+    assert verifier.max_in_flight >= 4, verifier.max_in_flight
+
+
+def test_pipeline_dedups_in_flight_duplicates(syncer_env):
+    """The same block retransmitted while its first copy is still being
+    verified must not be verified twice."""
+    from mysticeti_tpu.network import Blocks
+
+    committee, signers, make = syncer_env
+    verifier = SlowCountingVerifier(0.05)
+    ns = make(verifier)
+
+    blk = _peer_blocks(signers, 1)[0]
+    msgs = [Blocks((blk.to_bytes(),)) for _ in range(5)]
+
+    async def main():
+        await ns.start()
+        conn = FakeConnection(1, msgs)
+        task = asyncio.ensure_future(ns._connection_task(conn))
+        await asyncio.sleep(0.25)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        await ns.stop()
+
+    asyncio.run(main())
+    assert verifier.seen_refs.count(blk.reference) == 1, verifier.seen_refs
