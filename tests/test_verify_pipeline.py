@@ -89,14 +89,16 @@ def syncer_env(tmp_path):
     observer = TestCommitObserver(core.block_store, committee)
 
     class _NoNet:
-        connections = None
+        def __init__(self):
+            self.connections = asyncio.Queue()  # accept loop idles on this
 
         async def stop(self):
             pass
 
     def make(verifier):
         return NetworkSyncer(
-            core, observer, _NoNet(), parameters=Parameters(leader_timeout_s=10.0),
+            core, observer, _NoNet(),
+            parameters=Parameters(leader_timeout_s=10.0),
             block_verifier=verifier,
         )
 
@@ -126,18 +128,21 @@ def test_pipeline_overlaps_slow_verification(syncer_env):
     verification (serialized would take N*50 ms and max_in_flight == 1)."""
     from mysticeti_tpu.network import Blocks
 
+    from mysticeti_tpu.runtime.simulated import run_simulation
+
     committee, signers, make = syncer_env
     verifier = SlowCountingVerifier(0.05)
-    ns = make(verifier)
 
     blocks = _peer_blocks(signers, 3)  # 9 blocks
     msgs = [Blocks((b.to_bytes(),)) for b in blocks]
 
     async def main():
+        ns = make(verifier)
         await ns.start()
         conn = FakeConnection(1, msgs)
         task = asyncio.ensure_future(ns._connection_task(conn))
-        await asyncio.sleep(0.2)  # 9 x 50ms serialized would need 450ms
+        # Virtual time: 9 x 50 ms serialized would need 450 ms.
+        await asyncio.sleep(0.2)
         task.cancel()
         try:
             await task
@@ -145,7 +150,7 @@ def test_pipeline_overlaps_slow_verification(syncer_env):
             pass
         await ns.stop()
 
-    asyncio.run(main())
+    run_simulation(main(), seed=1)
     assert verifier.calls == 9
     assert verifier.max_in_flight >= 4, verifier.max_in_flight
 
@@ -155,14 +160,16 @@ def test_pipeline_dedups_in_flight_duplicates(syncer_env):
     verified must not be verified twice."""
     from mysticeti_tpu.network import Blocks
 
+    from mysticeti_tpu.runtime.simulated import run_simulation
+
     committee, signers, make = syncer_env
     verifier = SlowCountingVerifier(0.05)
-    ns = make(verifier)
 
     blk = _peer_blocks(signers, 1)[0]
     msgs = [Blocks((blk.to_bytes(),)) for _ in range(5)]
 
     async def main():
+        ns = make(verifier)
         await ns.start()
         conn = FakeConnection(1, msgs)
         task = asyncio.ensure_future(ns._connection_task(conn))
@@ -174,5 +181,5 @@ def test_pipeline_dedups_in_flight_duplicates(syncer_env):
             pass
         await ns.stop()
 
-    asyncio.run(main())
+    run_simulation(main(), seed=1)
     assert verifier.seen_refs.count(blk.reference) == 1, verifier.seen_refs
