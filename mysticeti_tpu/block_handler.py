@@ -32,15 +32,34 @@ from .types import (
     TransactionLocator,
 )
 
+MAX_PROPOSED_PER_BLOCK = 10000
+
+
+def _soft_max_from_env() -> int:
+    raw = os.environ.get("MYSTICETI_MAX_BLOCK_TX")
+    if raw is None:
+        return MAX_PROPOSED_PER_BLOCK
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"MYSTICETI_MAX_BLOCK_TX must be an integer, got {raw!r}"
+        ) from None
+    if not 1 <= value <= MAX_PROPOSED_PER_BLOCK:
+        raise ValueError(
+            f"MYSTICETI_MAX_BLOCK_TX={value} out of range [1,"
+            f" {MAX_PROPOSED_PER_BLOCK}] (the block_handler.rs SOFT_MAX regime"
+            " caps proposals at the hard per-block maximum)"
+        )
+    return value
+
+
 # Proposal drain cap (block_handler.rs SOFT_MAX equivalent).  Env-tunable:
 # shrinking it raises the block rate at a given load, which reproduces the
 # per-node block-arrival (and therefore signature-verification) rate of a
 # large WAN committee on a small local fleet — the verification-bound regime
 # of BASELINE configs #4/#5.
-SOFT_MAX_PROPOSED_PER_BLOCK = int(
-    os.environ.get("MYSTICETI_MAX_BLOCK_TX", str(10 * 1000))
-)
-MAX_PROPOSED_PER_BLOCK = 10000
+SOFT_MAX_PROPOSED_PER_BLOCK = _soft_max_from_env()
 
 
 class BlockHandler:
@@ -57,7 +76,10 @@ class BlockHandler:
     def state(self) -> bytes:
         raise NotImplementedError
 
-    def recover_state(self, state: bytes) -> None:
+    def recover_state(self, state: bytes, watermark_round=None) -> None:
+        """``watermark_round`` bounds the Byzantine-oracle leniency after
+        recovery (TransactionAggregator.with_state): pass the highest round
+        durably replayed alongside the snapshot."""
         raise NotImplementedError
 
     def cleanup(self) -> None:
@@ -185,8 +207,8 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
     def state(self) -> bytes:
         return self.transaction_votes.state()
 
-    def recover_state(self, state: bytes) -> None:
-        self.transaction_votes.with_state(state)
+    def recover_state(self, state: bytes, watermark_round=None) -> None:
+        self.transaction_votes.with_state(state, watermark_round)
 
     def cleanup(self) -> None:
         cutoff = time.time() - 10.0
@@ -255,9 +277,9 @@ class TestBlockHandler(BlockHandler):
         w.u64(self.last_transaction)
         return w.finish()
 
-    def recover_state(self, state: bytes) -> None:
+    def recover_state(self, state: bytes, watermark_round=None) -> None:
         r = Reader(state)
-        self.transaction_votes.with_state(r.bytes())
+        self.transaction_votes.with_state(r.bytes(), watermark_round)
         self.last_transaction = r.u64()
         r.expect_done()
 
@@ -294,5 +316,5 @@ class SimpleBlockHandler(BlockHandler):
     def state(self) -> bytes:
         return b""
 
-    def recover_state(self, state: bytes) -> None:
+    def recover_state(self, state: bytes, watermark_round=None) -> None:
         pass

@@ -214,9 +214,18 @@ class HybridSignatureVerifier(SignatureVerifier):
         self._fixed_threshold = threshold
         self.cpu_per_sig_s = 0.0
         self.tpu_dispatch_s = 0.0
-        # Set after every dispatch; the batching collector reports it as the
-        # metrics backend label so the cpu/tpu split is observable.
-        self.backend_label = "hybrid"
+        # EMA read-modify-writes happen from executor threads; serialize them.
+        self._ema_lock = threading.Lock()
+        # Routing label of the dispatch that ran in THIS thread: the batching
+        # collector reads it right after verify_signatures returns, in the
+        # same executor thread, so thread-local storage is exactly the
+        # lifetime needed — a concurrent flush routed the other way cannot
+        # overwrite it (it writes its own thread's slot).
+        self._tls = threading.local()
+
+    @property
+    def backend_label(self) -> str:
+        return getattr(self._tls, "label", "hybrid")
 
     def threshold(self) -> int:
         if self._fixed_threshold is not None:
@@ -239,11 +248,18 @@ class HybridSignatureVerifier(SignatureVerifier):
         pk = signer.public_key.bytes
         started = time.monotonic()
         self.tpu.verify_signatures([pk], [digest], [sig])
-        self.tpu_dispatch_s = time.monotonic() - started
+        tpu_probe = time.monotonic() - started
         started = time.monotonic()
         reps = 32
         self.cpu.verify_signatures([pk] * reps, [digest] * reps, [sig] * reps)
-        self.cpu_per_sig_s = (time.monotonic() - started) / reps
+        cpu_probe = (time.monotonic() - started) / reps
+        # Warmup runs on a background thread while live dispatches may
+        # already be updating the EMAs from executor threads — the
+        # calibration writes must join the same lock or a concurrent RMW
+        # that read the pre-warmup value could land after and discard them.
+        with self._ema_lock:
+            self.tpu_dispatch_s = tpu_probe
+            self.cpu_per_sig_s = cpu_probe
         log.info(
             "hybrid verifier calibrated: tpu dispatch %.1f ms, cpu %.0f µs/sig"
             " -> threshold %d",
@@ -259,19 +275,21 @@ class HybridSignatureVerifier(SignatureVerifier):
         if n < self.threshold():
             started = time.monotonic()
             out = self.cpu.verify_signatures(public_keys, digests, signatures)
-            self.cpu_per_sig_s = _update_ema(
-                self.cpu_per_sig_s,
-                (time.monotonic() - started) / n,
-                self.EMA_OUTLIER_S,
-            )
-            self.backend_label = "hybrid-cpu"
+            sample = (time.monotonic() - started) / n
+            with self._ema_lock:
+                self.cpu_per_sig_s = _update_ema(
+                    self.cpu_per_sig_s, sample, self.EMA_OUTLIER_S
+                )
+            self._tls.label = "hybrid-cpu"
             return out
         started = time.monotonic()
         out = self.tpu.verify_signatures(public_keys, digests, signatures)
-        self.tpu_dispatch_s = _update_ema(
-            self.tpu_dispatch_s, time.monotonic() - started, self.EMA_OUTLIER_S
-        )
-        self.backend_label = "hybrid-tpu"
+        sample = time.monotonic() - started
+        with self._ema_lock:
+            self.tpu_dispatch_s = _update_ema(
+                self.tpu_dispatch_s, sample, self.EMA_OUTLIER_S
+            )
+        self._tls.label = "hybrid-tpu"
         return out
 
 
@@ -502,13 +520,16 @@ class BatchedSignatureVerifier(BlockVerifier):
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting
             # connection tasks forever — fail every future in the batch.
+            # The ORIGINAL exception propagates (not a VerificationError):
+            # an infra failure is not evidence the signatures were invalid,
+            # and callers must be able to tell "reject this block" apart from
+            # "the verifier is down" (the latter resets the connection
+            # instead of flagging the peer Byzantine).
             log.error("signature verifier crashed on %d blocks: %r",
                       len(batch), exc)
             for _, future in batch:
                 if not future.done():
-                    future.set_exception(
-                        VerificationError(f"signature verifier crashed: {exc!r}")
-                    )
+                    future.set_exception(exc)
             return
         if self.metrics is not None:
             self.metrics.verify_batch_size.observe(len(batch))
@@ -527,11 +548,25 @@ class BatchedSignatureVerifier(BlockVerifier):
     async def verify_blocks(self, blocks: Sequence[StatementBlock]) -> List[bool]:
         """All blocks of a frame join the collector CONCURRENTLY — the base
         class's sequential per-block await would pay one collection window +
-        dispatch per block."""
+        dispatch per block.
+
+        Only VerificationError means "invalid signature" (False).  Anything
+        else — a JAX dispatch/compile crash, CancelledError during shutdown —
+        re-raises, matching the base class's except-VerificationError-only
+        semantics: infra failures must not masquerade as Byzantine rejections.
+        """
         results = await asyncio.gather(
             *(self.verify(b) for b in blocks), return_exceptions=True
         )
-        return [not isinstance(r, BaseException) for r in results]
+        out: List[bool] = []
+        for r in results:
+            if isinstance(r, VerificationError):
+                out.append(False)
+            elif isinstance(r, BaseException):
+                raise r
+            else:
+                out.append(True)
+        return out
 
     async def flush_now(self) -> None:
         """Test/shutdown hook: drain whatever is pending immediately."""

@@ -66,7 +66,10 @@ class TestCommitObserver(CommitObserver):
 
     def _recover_committed(self, recovered: CommitObserverRecoveredState) -> None:
         if recovered.state is not None:
-            self.transaction_votes.with_state(recovered.state)
+            self.transaction_votes.with_state(
+                recovered.state,
+                self.commit_interpreter.block_store.highest_round(),
+            )
         else:
             assert not recovered.sub_dags
         self.commit_interpreter.recover_state(recovered)

@@ -317,8 +317,13 @@ class TransactionAggregator:
         # (same as the reference, committee.rs:352-362), so after recovery
         # votes/shares for pre-snapshot transactions are EXPECTED, not
         # Byzantine — the duplicate/unknown oracles cannot assert what they
-        # did not persist and go lenient.
+        # did not persist.  Leniency is scoped by round: only locators whose
+        # sharing block's round is <= the recovery watermark (the highest
+        # round the restored state could have known about) bypass the
+        # oracles; anything first shared above the watermark is strictly
+        # checked for the aggregator's whole remaining life.
         self.recovered = False
+        self.recovered_watermark: Optional[int] = None
         # Native hot core (native/mysticeti_native.cpp VoteAggregator): the
         # per-offset Python objects (locator tuples, StakeAggregator
         # instances, set hashing) dominate the engine profile at load, so the
@@ -374,12 +379,31 @@ class TransactionAggregator:
         if self.track_processed and self._nat is None:
             self.processed.add(k)
 
+    def _pre_snapshot(self, k: TransactionLocator) -> bool:
+        """True when the locator may predate the recovered snapshot — the
+        oracles cannot assert what the snapshot did not persist."""
+        return (
+            self.recovered
+            and (
+                self.recovered_watermark is None
+                or k.block.round <= self.recovered_watermark
+            )
+        )
+
     def duplicate_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
-        if self.track_processed and not self.recovered and k not in self.processed:
+        if (
+            self.track_processed
+            and not self._pre_snapshot(k)
+            and k not in self.processed
+        ):
             raise RuntimeError(f"duplicate transaction {k} from {from_}")
 
     def unknown_transaction(self, k: TransactionLocator, from_: AuthorityIndex) -> None:
-        if self.track_processed and not self.recovered and k not in self.processed:
+        if (
+            self.track_processed
+            and not self._pre_snapshot(k)
+            and k not in self.processed
+        ):
             raise RuntimeError(f"vote for unknown transaction {k} from {from_}")
 
     def is_processed(self, k: TransactionLocator) -> bool:
@@ -584,10 +608,21 @@ class TransactionAggregator:
                 w.bytes(mask)
         return w.finish()
 
-    def with_state(self, state: bytes) -> None:
+    def with_state(
+        self, state: bytes, watermark_round: Optional[int] = None
+    ) -> None:
+        """Restore from a snapshot.  ``watermark_round`` bounds the Byzantine-
+        oracle leniency (see ``_pre_snapshot``): the caller should pass the
+        highest round durably replayed alongside the snapshot (e.g.
+        ``BlockStore.highest_round()``) so locators first shared ABOVE it stay
+        strictly checked.  When omitted the leniency is unbounded (pure
+        reference-parity behavior): the snapshot alone cannot bound what was
+        processed before it — completed transactions may sit at rounds above
+        any still-pending entry — so no safe round bound is derivable."""
         if len(self):
             raise RuntimeError("with_state requires an empty aggregator")
         self.recovered = True
+        self.recovered_watermark = watermark_round
         r = Reader(state)
         for _ in range(r.u32()):
             block_ref = BlockReference.decode(r)

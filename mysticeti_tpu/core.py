@@ -112,7 +112,9 @@ class Core:
             writer.insert_own_block(last_own_block)
 
         if recovered.state is not None:
-            block_handler.recover_state(recovered.state)
+            block_handler.recover_state(
+                recovered.state, watermark_round=block_store.highest_round()
+            )
 
         self.block_manager = BlockManager(block_store, len(committee), metrics)
         self.pending: Deque[Tuple[WalPosition, MetaStatement]] = pending

@@ -53,21 +53,36 @@ class CommandContext:
             parts.append(f"{key}={shlex.quote(value)}")
         if self.background is not None:
             log = self.log_file or "/dev/null"
-            inner = " ".join(parts + [command])
+            # The marker comment rides in the spawned shell's cmdline so the
+            # liveness probe below can tell OUR session apart from an
+            # unrelated process that recycled the pid after a crash.  The
+            # trailing marker no-op keeps the shell RESIDENT: with it, the
+            # command is not the tail of `sh -c`, so bash cannot exec-replace
+            # the shell (which would swap the cmdline out for the command's
+            # own argv and lose the marker on sh->bash hosts).
+            marker = f"mysticeti-session-{self.background}"
+            inner = (
+                f": {marker}; " + " ".join(parts + [command]) + f"; : {marker}"
+            )
             pidfile = self.pidfile()
             # Idempotent spawn: SshManager.execute retries on transient
             # failures, and a dropped connection after the remote process
             # launched would otherwise double-spawn it (and the pidfile would
             # only remember the last pid, orphaning the first).  Guard on a
             # live pidfile the way the reference's `tmux new -s <id>` fails
-            # fast on a duplicate session name (ssh.rs:83).
+            # fast on a duplicate session name (ssh.rs:83).  Liveness =
+            # process group alive AND the pid's cmdline carries our session
+            # marker: `kill -0` alone would trust any recycled pid and
+            # silently skip the respawn of a crashed node.
             spawn = (
                 f"setsid nohup sh -c {shlex.quote(inner)} > {log} 2>&1 &"
                 f" echo $! > {pidfile}"
             )
             return (
                 f"if [ -f {pidfile} ] && kill -0 -- -$(cat {pidfile})"
-                f" 2>/dev/null; then true; else {spawn}; fi"
+                f" 2>/dev/null && grep -aq -- {shlex.quote(marker)}"
+                f" /proc/$(cat {pidfile})/cmdline 2>/dev/null;"
+                f" then true; else {spawn}; fi"
             )
         return " ".join(parts + [command])
 

@@ -412,3 +412,28 @@ class TestNativeAggregatorParity:
             restored.with_state(agg.state())
             restored.vote(ghost, 1, c, [])  # no raise
             restored.register(ghost, 0, c)  # duplicate-share path, no raise
+
+    def test_recovery_watermark_scopes_leniency(self):
+        """with_state(watermark_round=R) scopes the post-recovery leniency:
+        locators at rounds <= R (possibly pre-snapshot) bypass the Byzantine
+        oracles, locators first shared ABOVE R stay strictly checked for the
+        aggregator's whole remaining life (regression: recovered=True used to
+        disable the duplicate/unknown oracles permanently)."""
+        c = Committee.new_test([1, 1, 1, 1])
+        nat, py = self._pair()
+        old = _block_with_shares(0, 4)  # round 1
+        ghost_old = TransactionLocatorRange(old.reference, 0, 4)
+        genesis = [StatementBlock.new_genesis(i) for i in range(4)]
+        new = StatementBlock.build(
+            0, 9, [g.reference for g in genesis],
+            [Share(bytes([i])) for i in range(4)],
+        )
+        ghost_new = TransactionLocatorRange(new.reference, 0, 4)
+        for agg in (nat, py):
+            restored = TransactionAggregator(QUORUM)
+            if agg is py:
+                restored._nat = None
+            restored.with_state(agg.state(), watermark_round=1)
+            restored.vote(ghost_old, 1, c, [])  # at watermark: tolerated
+            with pytest.raises(RuntimeError):
+                restored.vote(ghost_new, 1, c, [])  # above watermark: strict
