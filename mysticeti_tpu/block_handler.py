@@ -148,12 +148,23 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
             self._queue.append(transactions)
 
     def _receive_with_limit(self) -> Optional[List[bytes]]:
+        """Drain up to the SOFT_MAX budget, SLICING oversize submissions: the
+        generator submits 100 ms chunks (tps/10 transactions each), and
+        admitting a whole chunk because the budget had one slot left would
+        let every block overshoot the cap by the chunk size — turning the
+        block_handler.rs SOFT_MAX semantics (a per-block transaction cap)
+        into a no-op whenever tps/10 > SOFT_MAX.  The unconsumed remainder
+        stays queued for the next proposal."""
         if self.pending_transactions >= SOFT_MAX_PROPOSED_PER_BLOCK:
             return None
+        budget = SOFT_MAX_PROPOSED_PER_BLOCK - self.pending_transactions
         with self._queue_lock:
             if not self._queue:
                 return None
             received = self._queue.popleft()
+            if len(received) > budget:
+                self._queue.appendleft(received[budget:])
+                received = received[:budget]
         self.pending_transactions += len(received)
         return received
 

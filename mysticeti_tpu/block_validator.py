@@ -293,6 +293,83 @@ class HybridSignatureVerifier(SignatureVerifier):
         return out
 
 
+async def aggregate_verify(
+    blocks: Sequence[StatementBlock],
+    committee: Committee,
+    direct_verify,
+    count=None,
+) -> List[bool]:
+    """The threshold-aggregate acceptance rule over one batch of blocks
+    (shared by the frame-level ``ThresholdAggregateVerifier`` and the
+    collector-level aggregate mode of ``BatchedSignatureVerifier``).
+
+    ``direct_verify(sub_blocks) -> List[bool]`` is the inner signature check
+    (awaitable); ``count(aggregated, direct)`` is an optional accounting
+    callback.  See ``ThresholdAggregateVerifier`` for the safety argument:
+    acceptance is evaluated in descending-round order so every acceptance
+    chain terminates at directly verified frontier signatures.
+    """
+    n = len(blocks)
+    if count is None:
+        count = lambda aggregated, direct: None  # noqa: E731
+    if n <= 1:
+        count(0, n)
+        return list(await direct_verify(list(blocks)))
+    index_of = {b.reference: i for i, b in enumerate(blocks)}
+    # endorsers[i] = indexes of in-batch blocks that include block i.
+    endorsers: List[List[int]] = [[] for _ in range(n)]
+    for j, b in enumerate(blocks):
+        for ref in b.includes:
+            i = index_of.get(ref)
+            if i is not None:
+                endorsers[i].append(j)
+
+    quorum = committee.quorum_threshold()
+
+    def endorsement_stake(i, accepted_flags) -> int:
+        seen = set()
+        stake = 0
+        for j in endorsers[i]:
+            if accepted_flags[j] is not True:
+                continue
+            author = blocks[j].author()
+            if author in seen:
+                continue
+            seen.add(author)
+            stake += committee.get_stake(author)
+        return stake
+
+    # Frontier = blocks that cannot possibly reach quorum endorsement
+    # even if every endorser were accepted.
+    maybe: List[Optional[bool]] = [None] * n
+    all_true = [True] * n
+    frontier = [i for i in range(n) if endorsement_stake(i, all_true) < quorum]
+    direct = await direct_verify([blocks[i] for i in frontier])
+    for i, ok in zip(frontier, direct):
+        maybe[i] = bool(ok)
+    count(0, len(frontier))
+    # Descending-round acceptance: endorsers sit in strictly higher rounds
+    # than the blocks they include, so by the time a non-frontier block is
+    # evaluated every endorser's fate is known.
+    order = sorted(
+        (i for i in range(n) if maybe[i] is None),
+        key=lambda i: -blocks[i].round(),
+    )
+    for i in order:
+        maybe[i] = endorsement_stake(i, maybe) >= quorum
+        if maybe[i]:
+            count(1, 0)
+    unresolved = [i for i in order if maybe[i] is False]
+    if unresolved:
+        # Endorsement fell short once non-accepted endorsers were excluded:
+        # these still deserve a direct check rather than a blanket reject.
+        second = await direct_verify([blocks[i] for i in unresolved])
+        count(0, len(unresolved))
+        for i, ok in zip(unresolved, second):
+            maybe[i] = bool(ok)
+    return [bool(v) for v in maybe]
+
+
 class ThresholdAggregateVerifier(BlockVerifier):
     """Threshold-aggregate verification (BASELINE config #5's technique).
 
@@ -344,68 +421,9 @@ class ThresholdAggregateVerifier(BlockVerifier):
         await self.inner.verify(block)
 
     async def verify_blocks(self, blocks: Sequence[StatementBlock]) -> List[bool]:
-        n = len(blocks)
-        if n <= 1:
-            self._count(0, n)
-            return await self.inner.verify_blocks(blocks)
-        index_of = {b.reference: i for i, b in enumerate(blocks)}
-        # endorsers[i] = indexes of in-batch blocks that include block i.
-        endorsers: List[List[int]] = [[] for _ in range(n)]
-        for j, b in enumerate(blocks):
-            for ref in b.includes:
-                i = index_of.get(ref)
-                if i is not None:
-                    endorsers[i].append(j)
-
-        quorum = self.committee.quorum_threshold()
-
-        def endorsement_stake(i, accepted_flags) -> int:
-            seen = set()
-            stake = 0
-            for j in endorsers[i]:
-                if accepted_flags[j] is not True:
-                    continue
-                author = blocks[j].author()
-                if author in seen:
-                    continue
-                seen.add(author)
-                stake += self.committee.get_stake(author)
-            return stake
-
-        # Frontier = blocks that cannot possibly reach quorum endorsement
-        # even if every endorser were accepted.
-        maybe: List[Optional[bool]] = [None] * n
-        all_true = [True] * n
-        frontier = [
-            i for i in range(n) if endorsement_stake(i, all_true) < quorum
-        ]
-        direct = await self.inner.verify_blocks([blocks[i] for i in frontier])
-        for i, ok in zip(frontier, direct):
-            maybe[i] = bool(ok)
-        self._count(0, len(frontier))
-        # Descending-round acceptance: endorsers sit in strictly higher
-        # rounds than the blocks they include, so by the time a non-frontier
-        # block is evaluated every endorser's fate is known.
-        order = sorted(
-            (i for i in range(n) if maybe[i] is None),
-            key=lambda i: -blocks[i].round(),
+        return await aggregate_verify(
+            blocks, self.committee, self.inner.verify_blocks, self._count
         )
-        for i in order:
-            maybe[i] = endorsement_stake(i, maybe) >= quorum
-            if maybe[i]:
-                self._count(1, 0)
-        unresolved = [i for i in order if maybe[i] is False]
-        if unresolved:
-            # Endorsement fell short once non-accepted endorsers were
-            # excluded: these still deserve a direct check rather than a
-            # blanket reject.
-            second = await self.inner.verify_blocks(
-                [blocks[i] for i in unresolved]
-            )
-            self._count(0, len(unresolved))
-            for i, ok in zip(unresolved, second):
-                maybe[i] = bool(ok)
-        return [bool(v) for v in maybe]
 
 
 class BatchedSignatureVerifier(BlockVerifier):
@@ -431,12 +449,24 @@ class BatchedSignatureVerifier(BlockVerifier):
         max_batch: int = 256,
         max_delay_s: float = 0.005,
         metrics=None,
+        aggregate: bool = False,
     ) -> None:
         self.committee = committee
         self.verifier = verifier or TpuSignatureVerifier()
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.metrics = metrics
+        # Collector-level threshold aggregation (BASELINE #5's technique at
+        # the place it actually bites): one flush window pools blocks from
+        # EVERY peer connection, so the batch spans authors — exactly what
+        # quorum endorsement needs.  (A frame-level wrapper never sees that:
+        # the push disseminator's frames carry a single peer's own blocks,
+        # whose one author can never reach 2f+1 endorsement stake.)  Interior
+        # quorum-endorsed blocks skip the signature dispatch; only the
+        # frontier pays.
+        self.aggregate = aggregate
+        self.aggregated_total = 0
+        self.direct_total = 0
         self._pending: List[Tuple[StatementBlock, asyncio.Future]] = []
         self._lock = threading.Lock()
         self._flush_task: Optional[asyncio.TimerHandle] = None
@@ -495,28 +525,66 @@ class BatchedSignatureVerifier(BlockVerifier):
         if not batch:
             return
         blocks = [b for b, _ in batch]
-        pks = [self.committee.get_public_key(b.author()).bytes for b in blocks]
-        digests = [b.signed_digest() for b in blocks]
-        sigs = [b.signature for b in blocks]
         loop = asyncio.get_running_loop()
-        started = time.monotonic()
 
-        def _dispatch():
-            # The backend label must be captured in the same thread as the
-            # dispatch: reading it after the await would race with concurrent
-            # flushes that routed the other way (hybrid cpu/tpu split).
-            out = self.verifier.verify_signatures(pks, digests, sigs)
-            label = getattr(
-                self.verifier, "backend_label", type(self.verifier).__name__
+        async def _direct(sub_blocks) -> List[bool]:
+            if not sub_blocks:
+                return []
+            pks = [
+                self.committee.get_public_key(b.author()).bytes
+                for b in sub_blocks
+            ]
+            digests = [b.signed_digest() for b in sub_blocks]
+            sigs = [b.signature for b in sub_blocks]
+
+            def _dispatch():
+                # The backend label must be captured in the same thread as
+                # the dispatch: reading it after the await would race with
+                # concurrent flushes that routed the other way (hybrid
+                # cpu/tpu split).
+                out = self.verifier.verify_signatures(pks, digests, sigs)
+                label = getattr(
+                    self.verifier, "backend_label", type(self.verifier).__name__
+                )
+                return out, label
+
+            started = time.monotonic()
+            out, label = await loop.run_in_executor(None, _dispatch)
+            self._dispatch_ema_s = _update_ema(
+                self._dispatch_ema_s,
+                time.monotonic() - started,
+                self.EMA_OUTLIER_S,
             )
-            return out, label
+            # Backend counters measure ACTUAL dispatches: counted here, per
+            # dispatch, so aggregate-skipped blocks never inflate them.
+            if self.metrics is not None:
+                accepted = sum(bool(ok) for ok in out)
+                if accepted:
+                    self.metrics.verified_signatures_total.labels(
+                        label, "accepted"
+                    ).inc(accepted)
+                if accepted < len(out):
+                    self.metrics.verified_signatures_total.labels(
+                        label, "rejected"
+                    ).inc(len(out) - accepted)
+            return out
+
+        def _account(aggregated: int, direct: int) -> None:
+            self.aggregated_total += aggregated
+            self.direct_total += direct
+            if self.metrics is not None and aggregated:
+                self.metrics.verified_signatures_total.labels(
+                    "aggregate", "skipped"
+                ).inc(aggregated)
 
         try:
-            results, backend = await loop.run_in_executor(None, _dispatch)
-            elapsed = time.monotonic() - started
-            self._dispatch_ema_s = _update_ema(
-                self._dispatch_ema_s, elapsed, self.EMA_OUTLIER_S
-            )
+            if self.aggregate and len(blocks) > 1:
+                results = await aggregate_verify(
+                    blocks, self.committee, _direct, _account
+                )
+            else:
+                _account(0, len(blocks))
+                results = await _direct(blocks)
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting
             # connection tasks forever — fail every future in the batch.
@@ -533,14 +601,6 @@ class BatchedSignatureVerifier(BlockVerifier):
             return
         if self.metrics is not None:
             self.metrics.verify_batch_size.observe(len(batch))
-            accepted = sum(bool(ok) for ok in results)
-            self.metrics.verified_signatures_total.labels(backend, "accepted").inc(
-                accepted
-            )
-            if accepted < len(batch):
-                self.metrics.verified_signatures_total.labels(
-                    backend, "rejected"
-                ).inc(len(batch) - accepted)
         for (_, future), ok in zip(batch, results):
             if not future.done():
                 future.set_result(bool(ok))

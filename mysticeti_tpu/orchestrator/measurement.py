@@ -111,9 +111,16 @@ class MeasurementsCollection:
     def __init__(self, parameters: Optional[dict] = None) -> None:
         self.parameters = parameters or {}
         self.scrapers: Dict[str, List[Measurement]] = {}
+        # Host-level series (node_exporter equivalent, hostmon.py): one
+        # sample per scrape tick, so saturation is attributable to the host
+        # (core-steal between co-located validators) and not just the node.
+        self.host_samples: List[dict] = []
 
     def add(self, scraper_id: str, measurement: Measurement) -> None:
         self.scrapers.setdefault(scraper_id, []).append(measurement)
+
+    def add_host_sample(self, sample: dict) -> None:
+        self.host_samples.append(sample)
 
     def _last_measurements(self) -> List[Measurement]:
         return [series[-1] for series in self.scrapers.values() if series]
@@ -148,12 +155,64 @@ class MeasurementsCollection:
             (m.stdev_latency_s() for m in self._last_measurements()), default=0.0
         )
 
+    def host_summary(self) -> Optional[dict]:
+        """Aggregate the host series: system cpu avg/max, per-process cpu
+        averages, net throughput over the sampled span.  None without
+        samples (e.g. a runner that cannot observe its hosts)."""
+        samples = self.host_samples
+        if not samples:
+            return None
+        # SshRunner samples nest per-host dicts under "hosts" (one fleet
+        # sample covers N machines); flatten them into the same stream so the
+        # aggregation below reads both shapes.
+        flat: List[dict] = []
+        for s in samples:
+            if "hosts" in s:
+                flat.extend(s["hosts"].values())
+            else:
+                flat.append(s)
+        n_raw = len(samples)
+        samples = flat
+        cpu = [s["cpu_pct"] for s in samples if s.get("cpu_pct") is not None]
+        per: Dict[str, List[float]] = {}
+        for s in samples:
+            for name, p in (s.get("per_process") or {}).items():
+                if p.get("cpu_pct") is not None:
+                    per.setdefault(name, []).append(p["cpu_pct"])
+        out: dict = {"samples": n_raw}
+        if cpu:
+            out["cpu_pct_avg"] = round(sum(cpu) / len(cpu), 1)
+            out["cpu_pct_max"] = round(max(cpu), 1)
+        loads = [s["load_1m"] for s in samples if "load_1m" in s]
+        if loads:
+            out["load_1m_max"] = round(max(loads), 2)
+        if per:
+            out["per_process_cpu_pct_avg"] = {
+                k: round(sum(v) / len(v), 1) for k, v in sorted(per.items())
+            }
+        span = samples[-1].get("timestamp_s", 0) - samples[0].get(
+            "timestamp_s", 0
+        )
+        if span > 0 and "net_bytes_recv" in samples[-1]:
+            out["net_recv_mb_s"] = round(
+                (samples[-1]["net_bytes_recv"] - samples[0]["net_bytes_recv"])
+                / span / 2**20,
+                2,
+            )
+            out["net_sent_mb_s"] = round(
+                (samples[-1]["net_bytes_sent"] - samples[0]["net_bytes_sent"])
+                / span / 2**20,
+                2,
+            )
+        return out
+
     def save(self, path: str) -> None:
         data = {
             "parameters": self.parameters,
             "scrapers": {
                 k: [m.to_dict() for m in v] for k, v in self.scrapers.items()
             },
+            "host_samples": self.host_samples,
         }
         with open(path, "w") as f:
             json.dump(data, f, indent=1)
@@ -165,6 +224,7 @@ class MeasurementsCollection:
         c = cls(raw.get("parameters"))
         for k, series in raw.get("scrapers", {}).items():
             c.scrapers[k] = [Measurement.from_dict(m) for m in series]
+        c.host_samples = raw.get("host_samples", [])
         return c
 
     def display_summary(self) -> str:
@@ -176,4 +236,10 @@ class MeasurementsCollection:
             f" avg latency:   {self.aggregate_average_latency_s() * 1000:.0f} ms",
             f" stdev latency: {self.aggregate_stdev_latency_s() * 1000:.0f} ms",
         ]
+        host = self.host_summary()
+        if host and "cpu_pct_avg" in host:
+            lines.append(
+                f" host cpu:      {host['cpu_pct_avg']:.0f}% avg /"
+                f" {host['cpu_pct_max']:.0f}% max"
+            )
         return "\n".join(lines)

@@ -71,6 +71,11 @@ class Orchestrator:
                         str(authority),
                         Measurement.from_prometheus(text, self.workload),
                     )
+            # Host-level sample alongside the node scrapes (node_exporter
+            # equivalent): attributes saturation to the host, not the node.
+            host = await self.runner.host_sample()
+            if host is not None:
+                collection.add_host_sample(host)
             # Fault schedule (orchestrator.rs:543-583).
             if (
                 parameters.faults.kind != "none"

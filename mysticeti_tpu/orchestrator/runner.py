@@ -37,6 +37,12 @@ class Runner:
         """Fetch the node's /metrics text, or None when unreachable."""
         raise NotImplementedError
 
+    async def host_sample(self) -> Optional[dict]:
+        """One host-metrics sample covering the fleet (node_exporter
+        equivalent — hostmon.py); None when the runner cannot observe its
+        hosts."""
+        return None
+
     async def cleanup(self) -> None:
         raise NotImplementedError
 
@@ -71,6 +77,7 @@ class LocalProcessRunner(Runner):
         self.committee_size = 0
         self.processes: Dict[int, asyncio.subprocess.Process] = {}
         self.parameters: Optional[Parameters] = None
+        self._host_sampler = None
 
     async def configure(self, committee_size: int, load_tx_s: int = 0) -> None:
         self.committee_size = committee_size
@@ -157,6 +164,21 @@ class LocalProcessRunner(Runner):
     async def scrape(self, authority: int) -> Optional[str]:
         host, port = self.parameters.metrics_address(authority)
         return await _http_get_metrics("127.0.0.1", port)
+
+    async def host_sample(self) -> Optional[dict]:
+        if self._host_sampler is None:
+            try:
+                from .hostmon import HostSampler
+
+                self._host_sampler = HostSampler()
+            except ImportError:  # no psutil on this host: no host series
+                return None
+        pids = {
+            f"node-{a}": proc.pid
+            for a, proc in self.processes.items()
+            if proc.returncode is None
+        }
+        return self._host_sampler.sample(pids)
 
     async def cleanup(self) -> None:
         for authority in list(self.processes):
@@ -245,6 +267,25 @@ class SshRunner(Runner):
     async def scrape(self, authority: int) -> Optional[str]:
         host, port = self.parameters.metrics_address(authority)
         return await _http_get_metrics(self.hosts[authority].split("@")[-1], port)
+
+    async def host_sample(self) -> Optional[dict]:
+        from .hostmon import REMOTE_SAMPLE_CMD, parse_remote_sample
+        from .ssh import SshError
+
+        hosts = {}
+        for i, host in enumerate(self.hosts):
+            try:
+                out = await self.ssh.execute(host, REMOTE_SAMPLE_CMD)
+            except SshError:
+                continue
+            parsed = parse_remote_sample(out)
+            if parsed is not None:
+                hosts[f"host-{i}"] = parsed
+        if not hosts:
+            return None
+        import time as _time
+
+        return {"timestamp_s": _time.time(), "hosts": hosts}
 
     async def download_logs(self, dest_dir: str) -> List[str]:
         """Pull every node's log (orchestrator.rs log-download step)."""

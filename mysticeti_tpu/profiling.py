@@ -31,9 +31,19 @@ class SamplingProfiler:
     tick (~10 µs per thread) — cheap enough to run for a whole benchmark.
     """
 
-    def __init__(self, hz: float = DEFAULT_HZ) -> None:
+    def __init__(
+        self,
+        hz: float = DEFAULT_HZ,
+        flush_path: Optional[str] = None,
+        flush_every_s: float = 10.0,
+    ) -> None:
         self.interval_s = 1.0 / hz
         self.counts: Counter = Counter()
+        # Periodic flush: benchmark fleets kill nodes with SIGKILL (no
+        # shutdown path runs), so a profile that only writes at stop() would
+        # never land on disk — flush the folded file from the sampler thread.
+        self.flush_path = flush_path
+        self.flush_every_s = flush_every_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -66,6 +76,9 @@ class SamplingProfiler:
 
     def _run(self) -> None:
         me = threading.get_ident()
+        import time as _time
+
+        next_flush = _time.monotonic() + self.flush_every_s
         while not self._stop.wait(self.interval_s):
             for ident, top in sys._current_frames().items():
                 if ident == me:
@@ -79,6 +92,12 @@ class SamplingProfiler:
                     frame = frame.f_back
                 if frames:
                     self.counts[";".join(reversed(frames))] += 1
+            if self.flush_path and _time.monotonic() >= next_flush:
+                next_flush = _time.monotonic() + self.flush_every_s
+                try:
+                    self.write_folded(self.flush_path)
+                except OSError:
+                    pass
 
     # -- output --
 
@@ -87,9 +106,14 @@ class SamplingProfiler:
         return [f"{stack} {n}" for stack, n in self.counts.most_common()]
 
     def write_folded(self, path: str) -> None:
-        with open(path, "w") as f:
+        # Atomic swap: the periodic flush exists to survive SIGKILL, so a
+        # kill landing mid-write must not destroy the previous complete
+        # flush with a truncated file.
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
             for line in self.folded():
                 f.write(line + "\n")
+        os.replace(tmp, path)
 
 
 # ---------------------------------------------------------------------------
@@ -210,7 +234,10 @@ def start_from_env() -> Optional[SamplingProfiler]:
     path = os.environ.get("MYSTICETI_PROFILE")
     if not path or _active is not None:
         return None
-    _active = SamplingProfiler().start()
+    # "%p" -> pid so one env var serves a whole local fleet without the
+    # nodes clobbering each other's profiles.
+    path = path.replace("%p", str(os.getpid()))
+    _active = SamplingProfiler(flush_path=path).start()
     return _active
 
 
@@ -219,6 +246,7 @@ def stop_from_env() -> None:
     path = os.environ.get("MYSTICETI_PROFILE")
     if _active is None or not path:
         return
+    path = path.replace("%p", str(os.getpid()))
     _active.stop()
     _active.write_folded(path)
     render_file(path)

@@ -55,6 +55,65 @@ def test_measurements_collection_aggregation(tmp_path):
     assert "tps" in loaded.display_summary()
 
 
+def test_host_sampler_and_summary(tmp_path):
+    """node_exporter-equivalent host series: the sampler observes this
+    process, the collection aggregates and round-trips it through save/load."""
+    import os
+    import time
+
+    from mysticeti_tpu.orchestrator.hostmon import (
+        HostSampler,
+        parse_remote_sample,
+    )
+
+    sampler = HostSampler()
+    pids = {"node-0": os.getpid()}
+    first = sampler.sample(pids)
+    assert first["per_process"]["node-0"]["cpu_pct"] is None  # no interval yet
+    assert first["per_process"]["node-0"]["rss_mb"] > 0
+    time.sleep(0.05)
+    second = sampler.sample(pids)
+    assert second["per_process"]["node-0"]["cpu_pct"] is not None
+    assert second["mem_available_mb"] > 0
+
+    c = MeasurementsCollection({"nodes": 1})
+    c.add("0", Measurement.from_prometheus(SCRAPE, "shared"))
+    c.add_host_sample(first)
+    c.add_host_sample(second)
+    summary = c.host_summary()
+    assert summary["samples"] == 2
+    assert "cpu_pct_avg" in summary
+    assert summary["per_process_cpu_pct_avg"]["node-0"] >= 0
+    path = str(tmp_path / "m.json")
+    c.save(path)
+    loaded = MeasurementsCollection.load(path)
+    assert loaded.host_summary()["samples"] == 2
+    assert "host cpu" in loaded.display_summary()
+
+    # Dead pid: sampled gracefully (skipped), no crash.
+    gone = sampler.sample({"node-1": 2**22 + 12345})
+    assert "node-1" not in gone["per_process"]
+
+    # Remote (ssh) sample parsing.
+    parsed = parse_remote_sample(
+        "0.42 0.30 0.20 1/123 4567\n"
+        "MemTotal:       16384000 kB\n"
+        "MemAvailable:    8192000 kB\n"
+    )
+    assert parsed["load_1m"] == pytest.approx(0.42)
+    assert parsed["mem_available_mb"] == pytest.approx(8000.0)
+    assert parse_remote_sample("garbage") is None
+
+    # SshRunner nests per-host samples under "hosts"; the summary flattens.
+    remote = MeasurementsCollection({"nodes": 2})
+    remote.add_host_sample(
+        {"timestamp_s": 1.0, "hosts": {"host-0": parsed, "host-1": parsed}}
+    )
+    rs = remote.host_summary()
+    assert rs["samples"] == 1
+    assert rs["load_1m_max"] == pytest.approx(0.42)
+
+
 def _collection(load, tps, latency):
     c = MeasurementsCollection()
     m = Measurement(

@@ -159,17 +159,97 @@ def test_singletons_bypass_aggregation(setup):
 
 
 def test_make_verifier_agg_kinds(monkeypatch):
+    """"-agg" kinds enable COLLECTOR-level aggregation: the flush window
+    pools blocks from every peer connection, which is where multi-author
+    (quorum-capable) batches actually form — a frame-level wrapper only ever
+    sees one peer's own single-author frames at steady state."""
     from mysticeti_tpu import block_validator as bv
     from mysticeti_tpu.validator import _make_verifier
 
     monkeypatch.setattr(bv.HybridSignatureVerifier, "warmup", lambda self: None)
     committee = Committee.new_for_benchmarks(4)
     v = _make_verifier("cpu-agg", committee)
-    assert isinstance(v, bv.ThresholdAggregateVerifier)
-    assert isinstance(v.inner, bv.BatchedSignatureVerifier)
+    assert isinstance(v, bv.BatchedSignatureVerifier) and v.aggregate
+    assert isinstance(v.verifier, bv.CpuSignatureVerifier)
     v = _make_verifier("tpu-agg", committee)
-    assert isinstance(v, bv.ThresholdAggregateVerifier)
-    assert isinstance(v.inner.verifier, bv.HybridSignatureVerifier)
+    assert isinstance(v, bv.BatchedSignatureVerifier) and v.aggregate
+    assert isinstance(v.verifier, bv.HybridSignatureVerifier)
+    v = _make_verifier("cpu", committee)
+    assert isinstance(v, bv.BatchedSignatureVerifier) and not v.aggregate
+
+
+class CountingSigVerifier(CpuSignatureVerifier):
+    def __init__(self):
+        self.dispatched = 0
+
+    def verify_signatures(self, pks, digests, sigs):
+        self.dispatched += len(sigs)
+        return super().verify_signatures(pks, digests, sigs)
+
+
+def test_collector_aggregation_skips_interior(setup):
+    """Blocks arriving concurrently (as from many peer connections) pool in
+    one flush window; only the frontier pays a signature dispatch."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        blocks = _dag(signers, rounds=5)
+        results = await collector.verify_blocks(blocks)
+        assert all(results)
+        assert sig.dispatched == 4  # frontier only (round 5)
+        assert collector.aggregated_total == 16
+        assert collector.direct_total == 4
+
+    asyncio.run(main())
+
+
+def test_collector_aggregation_rejects_forged_frontier(setup):
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        blocks = _dag(signers, rounds=3, forge={(3, 1)})
+        results = await collector.verify_blocks(blocks)
+        by_ref = dict(zip((b.reference for b in blocks), results))
+        for b in blocks:
+            expected = not (b.round() == 3 and b.author() == 1)
+            assert by_ref[b.reference] == expected, b.reference
+
+    asyncio.run(main())
+
+
+def test_collector_aggregation_single_author_stream_never_skips(setup):
+    """One peer's own-block push stream (single author) can never reach
+    quorum endorsement — every block is verified directly."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        genesis = [StatementBlock.new_genesis(a) for a in range(4)]
+        prev = [g.reference for g in genesis]
+        chain = []
+        for r in range(1, 9):
+            blk = StatementBlock.build(
+                0, r, prev, [Share(bytes([r]))], signer=signers[0]
+            )
+            chain.append(blk)
+            prev = [blk.reference]
+        results = await collector.verify_blocks(chain)
+        assert all(results)
+        assert sig.dispatched == len(chain)
+        assert collector.aggregated_total == 0
+
+    asyncio.run(main())
 
 
 def test_validators_commit_with_aggregate_verifier(tmp_path):

@@ -56,14 +56,16 @@ async def search_one(verifier: str, nodes: int, start_load: int,
     for c in collections:
         tps = c.aggregate_tps()
         peak = max(peak, tps)
-        probes.append(
-            {
-                "offered_load_tx_s": c.parameters["load"],
-                "tps": round(tps, 1),
-                "avg_latency_s": round(c.aggregate_average_latency_s(), 4),
-                "stdev_latency_s": round(c.aggregate_stdev_latency_s(), 4),
-            }
-        )
+        probe = {
+            "offered_load_tx_s": c.parameters["load"],
+            "tps": round(tps, 1),
+            "avg_latency_s": round(c.aggregate_average_latency_s(), 4),
+            "stdev_latency_s": round(c.aggregate_stdev_latency_s(), 4),
+        }
+        host = c.host_summary()
+        if host is not None:
+            probe["host"] = host
+        probes.append(probe)
     return {
         "verifier": verifier,
         "nodes": nodes,
@@ -83,7 +85,7 @@ def main() -> None:
     parser.add_argument("--out", default="MAXLOAD.json")
     parser.add_argument(
         "--verifiers", nargs="+", default=["cpu"],
-        choices=["accept", "cpu", "tpu", "tpu-only"],
+        choices=["accept", "cpu", "tpu", "tpu-only", "cpu-agg", "tpu-agg"],
     )
     args = parser.parse_args()
 
