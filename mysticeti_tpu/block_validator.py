@@ -542,7 +542,13 @@ class BatchedSignatureVerifier(BlockVerifier):
                 # the dispatch: reading it after the await would race with
                 # concurrent flushes that routed the other way (hybrid
                 # cpu/tpu split).
-                out = self.verifier.verify_signatures(pks, digests, sigs)
+                if self.metrics is not None:
+                    with self.metrics.utilization_timer("verify:dispatch"):
+                        out = self.verifier.verify_signatures(
+                            pks, digests, sigs
+                        )
+                else:
+                    out = self.verifier.verify_signatures(pks, digests, sigs)
                 label = getattr(
                     self.verifier, "backend_label", type(self.verifier).__name__
                 )

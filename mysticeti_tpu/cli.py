@@ -74,6 +74,19 @@ async def run_node(
     from .profiling import start_from_env, stop_from_env
 
     start_from_env()  # MYSTICETI_PROFILE=<path>.folded: lifetime flamegraph
+    # MYSTICETI_CPROFILE=<path> (+ optional MYSTICETI_EXIT_AFTER=<s>): exact
+    # deterministic profile of the node's event loop, dumped on clean exit —
+    # the sampling profiler can't attribute C-extension time and benchmark
+    # fleets SIGKILL their nodes, so a timed clean exit is the way to get a
+    # trustworthy in-fleet profile.
+    cprofile_path = os.environ.get("MYSTICETI_CPROFILE")
+    profiler = None
+    if cprofile_path:
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+    exit_after = float(os.environ.get("MYSTICETI_EXIT_AFTER", "0") or 0)
     committee = Committee.load(committee_path)
     parameters = Parameters.load(parameters_path)
     private = PrivateConfig.new_in_dir(authority, private_dir)
@@ -90,8 +103,21 @@ async def run_node(
         verifier=verifier,
     )
     try:
-        await validator.network_syncer.await_completion()
+        if exit_after > 0:
+            try:
+                await asyncio.wait_for(
+                    validator.network_syncer.await_completion(), exit_after
+                )
+            except asyncio.TimeoutError:
+                await validator.stop()  # clean WAL close + network shutdown
+        else:
+            await validator.network_syncer.await_completion()
     finally:
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(
+                cprofile_path.replace("%p", str(os.getpid()))
+            )
         stop_from_env()
 
 

@@ -578,6 +578,13 @@ class TransactionAggregator:
 
     def state(self) -> bytes:
         if self._nat is not None:
+            if hasattr(self._nat_mod, "va_state"):
+                # Snapshot serialized entirely in C++ — the per-commit state
+                # write is the engine's top cost at deep pending backlogs
+                # (O(pending) every commit); _nat_state below is the
+                # byte-identical reference encoder it is differential-tested
+                # against.
+                return self._nat_mod.va_state(self._nat)
             return self._nat_state()
         w = Writer()
         w.u32(len(self.pending))

@@ -23,8 +23,9 @@ CORE_QUEUE_SIZE = 32
 
 
 class CoreTaskDispatcher:
-    def __init__(self, syncer: Syncer) -> None:
+    def __init__(self, syncer: Syncer, metrics=None) -> None:
         self.syncer = syncer
+        self.metrics = metrics
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=CORE_QUEUE_SIZE)
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
@@ -34,10 +35,20 @@ class CoreTaskDispatcher:
         return self
 
     async def _run(self) -> None:
+        # Every consensus mutation flows through here, so timing each command
+        # gives the utilization breakdown the reference gets from its
+        # UtilizationTimer instrumentation of the core thread
+        # (core.rs/core_thread) — scrapeable as utilization_timer{proc=...}.
+        timers = self.metrics.utilization_timer if self.metrics else None
         while True:
             command, args, reply = await self._queue.get()
             try:
-                result = command(*args)
+                if timers is not None:
+                    label = getattr(command, "__name__", "other")
+                    with timers(f"core:{label}"):
+                        result = command(*args)
+                else:
+                    result = command(*args)
                 if reply is not None and not reply.done():
                     reply.set_result(result)
             except Exception as e:  # propagate to the caller, keep the loop alive

@@ -17,6 +17,7 @@ Capability parity with ``mysticeti-core/src/net_sync.rs``:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import threading
 from typing import Dict, List, Optional, Set
 
@@ -106,7 +107,13 @@ class NetworkSyncer:
         self.network = network
         self.block_verifier = block_verifier or AcceptAllBlockVerifier()
         self.metrics = metrics
-        self.dispatcher = CoreTaskDispatcher(self.syncer)
+        self.dispatcher = CoreTaskDispatcher(self.syncer, metrics=metrics)
+        # Bound once: _decode_fresh is per-incoming-frame hot.
+        self._utilization_timer = (
+            metrics.utilization_timer
+            if metrics is not None
+            else (lambda _name: contextlib.nullcontext())
+        )
         self.connections: Dict[int, Connection] = {}
         self.connected_authorities = AuthoritySet()
         self.fetcher = BlockFetcher(
@@ -307,27 +314,30 @@ class NetworkSyncer:
     async def _decode_fresh(self, serialized_blocks) -> List[StatementBlock]:
         """Stage 1 (host, fast): parse, dedup via the core task, consensus-
         rule checks."""
+        timer = self._utilization_timer
         blocks: List[StatementBlock] = []
-        for raw in serialized_blocks:
-            try:
-                block = StatementBlock.from_bytes(raw)
-            except Exception:
-                log.warning("dropping malformed block bytes from peer")
-                continue  # malformed: drop (byzantine peer)
-            blocks.append(block)
+        with timer("net:decode"):
+            for raw in serialized_blocks:
+                try:
+                    block = StatementBlock.from_bytes(raw)
+                except Exception:
+                    log.warning("dropping malformed block bytes from peer")
+                    continue  # malformed: drop (byzantine peer)
+                blocks.append(block)
         if not blocks:
             return []
         # Dedup through the core task before paying for verification.
         processed = await self.dispatcher.processed([b.reference for b in blocks])
         fresh = [b for b, done in zip(blocks, processed) if not done]
         verified: List[StatementBlock] = []
-        for block in fresh:
-            try:
-                block.verify_structure(self.core.committee)
-            except VerificationError as exc:
-                log.warning("rejecting block %r: %s", block.reference, exc)
-                continue
-            verified.append(block)
+        with timer("net:verify_structure"):
+            for block in fresh:
+                try:
+                    block.verify_structure(self.core.committee)
+                except VerificationError as exc:
+                    log.warning("rejecting block %r: %s", block.reference, exc)
+                    continue
+                verified.append(block)
         return verified
 
     async def _verify_accepted(

@@ -413,6 +413,37 @@ class TestNativeAggregatorParity:
             restored.vote(ghost, 1, c, [])  # no raise
             restored.register(ghost, 0, c)  # duplicate-share path, no raise
 
+    def test_native_state_serializer_matches_python_encoder(self):
+        """va_state (all-C++ snapshot) is byte-identical to the reference
+        Python encoder (_nat_state) across many blocks — covers the sort
+        order (authority, round, digest) and the full range layout."""
+        import pytest as _pytest
+
+        from mysticeti_tpu.native import native as _native
+
+        if _native is None or not hasattr(_native, "va_state"):
+            _pytest.skip("native extension unavailable")
+        c = Committee.new_test([1, 1, 1, 1])
+        nat, _ = self._pair()
+        genesis = [StatementBlock.new_genesis(a) for a in range(4)]
+        prev = [g.reference for g in genesis]
+        for r in range(1, 9):
+            layer = []
+            for a in range(4):
+                blk = StatementBlock.build(
+                    a, r, prev, [Share(bytes([r, a, i])) for i in range(6)]
+                )
+                layer.append(blk)
+                nat.process_block(blk, None, c)
+                if r % 2 == 0:
+                    nat.vote(
+                        TransactionLocatorRange(blk.reference, 0, 3),
+                        (a + 1) % 4, c, [],
+                    )
+            prev = [b.reference for b in layer]
+        assert len(nat) > 4
+        assert _native.va_state(nat._nat) == nat._nat_state()
+
     def test_recovery_watermark_scopes_leniency(self):
         """with_state(watermark_round=R) scopes the post-recovery leniency:
         locators at rounds <= R (possibly pre-snapshot) bypass the Byzantine
