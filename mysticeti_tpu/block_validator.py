@@ -16,8 +16,10 @@ Split of responsibilities on the receive path:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import threading
 import time
+from itertools import islice
 from typing import List, Optional, Sequence, Tuple
 
 from .committee import Committee
@@ -298,6 +300,7 @@ async def aggregate_verify(
     committee: Committee,
     direct_verify,
     count=None,
+    prior_endorsers=None,
 ) -> List[bool]:
     """The threshold-aggregate acceptance rule over one batch of blocks
     (shared by the frame-level ``ThresholdAggregateVerifier`` and the
@@ -305,14 +308,22 @@ async def aggregate_verify(
 
     ``direct_verify(sub_blocks) -> List[bool]`` is the inner signature check
     (awaitable); ``count(aggregated, direct)`` is an optional accounting
-    callback.  See ``ThresholdAggregateVerifier`` for the safety argument:
-    acceptance is evaluated in descending-round order so every acceptance
-    chain terminates at directly verified frontier signatures.
+    callback.  ``prior_endorsers(ref) -> set[AuthorityIndex]`` optionally
+    supplies authors of PREVIOUSLY ACCEPTED blocks that include ``ref``
+    (every accepted block was itself signature-verified or quorum-endorsed,
+    so its endorsement carries inductively) — this is what makes the rule
+    bite during catch-up, where peers' own-block streams run at different
+    round offsets and a block's verified children usually arrived earlier
+    via a faster stream.  See ``ThresholdAggregateVerifier`` for the safety
+    argument: acceptance is evaluated in descending-round order so every
+    acceptance chain terminates at directly verified signatures.
     """
     n = len(blocks)
     if count is None:
         count = lambda aggregated, direct: None  # noqa: E731
-    if n <= 1:
+    if n == 0:
+        return []
+    if n == 1 and prior_endorsers is None:
         count(0, n)
         return list(await direct_verify(list(blocks)))
     index_of = {b.reference: i for i, b in enumerate(blocks)}
@@ -327,8 +338,12 @@ async def aggregate_verify(
     quorum = committee.quorum_threshold()
 
     def endorsement_stake(i, accepted_flags) -> int:
-        seen = set()
-        stake = 0
+        seen = (
+            set(prior_endorsers(blocks[i].reference))
+            if prior_endorsers is not None
+            else set()
+        )
+        stake = sum(committee.get_stake(a) for a in seen)
         for j in endorsers[i]:
             if accepted_flags[j] is not True:
                 continue
@@ -467,6 +482,16 @@ class BatchedSignatureVerifier(BlockVerifier):
         self.aggregate = aggregate
         self.aggregated_total = 0
         self.direct_total = 0
+        # Cross-flush endorsement index: ref -> authors of ACCEPTED blocks
+        # that include it.  Catch-up streams from different peers run at
+        # different round offsets, so a backlog block's quorum of verified
+        # children has usually been accepted in EARLIER flushes — in-batch
+        # endorsement alone almost never fires there.  Strictly size-bounded
+        # with insertion-order (FIFO) eviction: rounds CLAIMED by blocks are
+        # attacker-controlled (a Byzantine author can sign structure-valid
+        # blocks at arbitrary rounds over fabricated include refs), so
+        # neither the prune window nor residency may key on them.
+        self._endorsements: dict = {}
         self._pending: List[Tuple[StatementBlock, asyncio.Future]] = []
         self._lock = threading.Lock()
         self._flush_task: Optional[asyncio.TimerHandle] = None
@@ -542,12 +567,12 @@ class BatchedSignatureVerifier(BlockVerifier):
                 # the dispatch: reading it after the await would race with
                 # concurrent flushes that routed the other way (hybrid
                 # cpu/tpu split).
-                if self.metrics is not None:
-                    with self.metrics.utilization_timer("verify:dispatch"):
-                        out = self.verifier.verify_signatures(
-                            pks, digests, sigs
-                        )
-                else:
+                timer = (
+                    self.metrics.utilization_timer("verify:dispatch")
+                    if self.metrics is not None
+                    else contextlib.nullcontext()
+                )
+                with timer:
                     out = self.verifier.verify_signatures(pks, digests, sigs)
                 label = getattr(
                     self.verifier, "backend_label", type(self.verifier).__name__
@@ -584,10 +609,12 @@ class BatchedSignatureVerifier(BlockVerifier):
                 ).inc(aggregated)
 
         try:
-            if self.aggregate and len(blocks) > 1:
+            if self.aggregate:
                 results = await aggregate_verify(
-                    blocks, self.committee, _direct, _account
+                    blocks, self.committee, _direct, _account,
+                    prior_endorsers=self._prior_endorsers,
                 )
+                self._note_endorsements(blocks, results)
             else:
                 _account(0, len(blocks))
                 results = await _direct(blocks)
@@ -633,6 +660,37 @@ class BatchedSignatureVerifier(BlockVerifier):
             else:
                 out.append(True)
         return out
+
+    ENDORSEMENT_MAX_ENTRIES = 200_000  # hard cap; FIFO eviction beyond it
+
+    _EMPTY = frozenset()
+
+    def _prior_endorsers(self, ref):
+        # Callers must not mutate (endorsement_stake copies before mutating).
+        return self._endorsements.get(ref, self._EMPTY)
+
+    def _note_endorsements(self, blocks, results) -> None:
+        """Record accepted blocks' includes in the endorsement index; only
+        ACCEPTED blocks endorse (each was signature-verified or quorum-
+        endorsed itself, so the license carries inductively).  Eviction is
+        strictly by first-endorsement insertion order — recent entries (the
+        live catch-up window) survive regardless of the rounds blocks CLAIM."""
+        endorsements = self._endorsements
+        for block, ok in zip(blocks, results):
+            if not ok:
+                continue
+            author = block.author()
+            for ref in block.includes:
+                prev = endorsements.get(ref)
+                if prev is None:
+                    endorsements[ref] = {author}
+                else:
+                    prev.add(author)
+        excess = len(endorsements) - self.ENDORSEMENT_MAX_ENTRIES
+        if excess > 0:
+            # dicts iterate in insertion order: drop the oldest entries.
+            for ref in list(islice(iter(endorsements), excess)):
+                del endorsements[ref]
 
     async def flush_now(self) -> None:
         """Test/shutdown hook: drain whatever is pending immediately."""

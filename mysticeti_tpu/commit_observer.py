@@ -85,6 +85,12 @@ class TestCommitObserver(CommitObserver):
                     self.transaction_votes.process_block(block, None, self.committee)
                 if self.metrics is not None:
                     txs.extend(t for _, t in block.shared_transactions())
+        if committed and self.metrics is not None:
+            self.metrics.commit_round.set(committed[-1].anchor.round)
+            for commit in committed:
+                self.metrics.committed_leaders_total.labels(
+                    str(commit.anchor.authority), "committed"
+                ).inc()
         if txs:
             self._update_metrics_batch(txs, now)
         return committed

@@ -17,7 +17,10 @@ import asyncio
 from typing import List, Optional, Sequence, Set, Tuple
 
 from .syncer import Syncer
+from .tracing import logger
 from .types import AuthoritySet, BlockReference, RoundNumber, StatementBlock
+
+log = logger(__name__)
 
 CORE_QUEUE_SIZE = 32
 
@@ -55,7 +58,14 @@ class CoreTaskDispatcher:
                 if reply is not None and not reply.done():
                     reply.set_exception(e)
                 else:
-                    raise
+                    # Caller gone (connection task cancelled mid-await): the
+                    # owner loop must survive — dying here would wedge every
+                    # future consensus command fleet-wide, turning one
+                    # connection teardown into a total liveness failure.
+                    log.exception(
+                        "core command %s failed with no live caller",
+                        getattr(command, "__name__", command),
+                    )
 
     async def _call(self, fn, *args):
         reply: asyncio.Future = asyncio.get_running_loop().create_future()

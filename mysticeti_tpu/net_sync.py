@@ -378,7 +378,11 @@ class NetworkSyncer:
     # -- background tasks --
 
     async def _leader_timeout_task(self) -> None:
-        """net_sync.rs:401-444: force a proposal if the round stalls."""
+        """net_sync.rs:401-444: force a proposal if the round stalls.
+
+        The task must outlive individual command failures: it is the
+        liveness backstop, and an exception escaping this loop would
+        silently remove the fleet's only stall-recovery mechanism."""
         timeout = self.parameters.leader_timeout_s
         while True:
             waiter = self.signals.round_notify.subscribe()
@@ -391,9 +395,14 @@ class NetworkSyncer:
                 log.debug(
                     "leader timeout at round %d: forcing proposal", round_at_start
                 )
-                await self.dispatcher.force_new_block(
-                    round_at_start + 1, self.connected_authorities.copy()
-                )
+                try:
+                    await self.dispatcher.force_new_block(
+                        round_at_start + 1, self.connected_authorities.copy()
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("forced proposal failed; timeout task lives on")
 
     async def _epoch_watch_task(self) -> None:
         """Epoch-aware shutdown (net_sync.rs:466-494): once the epoch is SAFE

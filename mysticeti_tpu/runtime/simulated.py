@@ -96,6 +96,9 @@ def run_simulation(main: Awaitable, seed: int = 0, timeout_s: Optional[float] = 
     reproducibly, since everything is seeded.
     """
     loop = DeterministicLoop(seed)
+    from mysticeti_tpu.types import StatementBlock
+
+    StatementBlock.enable_decode_memo()
     try:
         asyncio.set_event_loop(loop)
         if timeout_s is not None:
@@ -112,5 +115,6 @@ def run_simulation(main: Awaitable, seed: int = 0, timeout_s: Optional[float] = 
             )
         return result
     finally:
+        StatementBlock.disable_decode_memo()
         asyncio.set_event_loop(None)
         loop.close()

@@ -56,6 +56,8 @@ class Syncer:
         new_round = self.core.current_round()
         if new_round > previous_round:
             self.signals.new_round(new_round)
+            if self.metrics is not None:
+                self.metrics.threshold_clock_round.set(new_round)
         self.try_new_block(connected_authorities)
         return missing_references
 

@@ -400,6 +400,23 @@ class StatementBlock:
         """
         return crypto.blake2b_256(self.signed_bytes())
 
+    # Decode memo, enabled ONLY by the deterministic simulator
+    # (runtime/simulated.py): all N simulated validators live in one process
+    # and each decodes the same serialized block once — memoizing turns the
+    # sim's dominant cost (N redundant decodes per block) into one.  Blocks
+    # are immutable after construction, so instance sharing across in-process
+    # nodes is safe.  Never enabled on real nodes (each is its own process).
+    _decode_memo: Optional[dict] = None
+    _DECODE_MEMO_CAP = 8192
+
+    @classmethod
+    def enable_decode_memo(cls) -> None:
+        cls._decode_memo = {}
+
+    @classmethod
+    def disable_decode_memo(cls) -> None:
+        cls._decode_memo = None
+
     @classmethod
     def from_bytes(cls, data: bytes) -> "StatementBlock":
         """Single-pass inline decoder.
@@ -409,6 +426,13 @@ class StatementBlock:
         load (millions of ``_take`` calls), so this path unpacks with local
         offsets.  Error semantics match: any truncation, bad tag, invalid
         vote byte, or trailing garbage raises SerdeError."""
+        memo = cls._decode_memo
+        if memo is not None:
+            if not isinstance(data, bytes):  # mmap/memoryview callers
+                data = bytes(data)
+            cached = memo.get(data)
+            if cached is not None:
+                return cached
         try:
             n = len(data)
             authority, round_ = _U64X2.unpack_from(data, 0)
@@ -499,10 +523,15 @@ class StatementBlock:
             raise SerdeError("truncated input") from None
         digest = crypto.blake2b_256(data)
         ref = BlockReference(authority, round_, digest)
-        return cls(
+        block = cls(
             ref, tuple(includes), tuple(statements), meta_ns, epoch_marker,
             epoch, signature, _bytes=bytes(data),
         )
+        if memo is not None:
+            if len(memo) >= cls._DECODE_MEMO_CAP:
+                memo.clear()  # bulk FIFO: sims re-see bytes within a window
+            memo[block._bytes] = block
+        return block
 
     # -- accessors --
 

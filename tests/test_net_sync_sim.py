@@ -184,18 +184,55 @@ def test_fifty_nodes_commit(tmp_path):
         leader_election=STAKE_WEIGHTED,
     )
     nodes = run_simulation(
-        _run_nodes(n, str(tmp_path), 10.0, committee=committee), seed=29
+        _run_nodes(n, str(tmp_path), 6.0, committee=committee), seed=29
     )
     sequences = [_committed(node) for node in nodes]
     # Commit-prefix consistency (safety) across all 50 validators...
     _assert_prefix_consistent(sequences)
     # ...with liveness: every node commits leaders, and progress is shared.
-    assert all(len(s) >= 20 for s in sequences), sorted(len(s) for s in sequences)[:5]
+    # (6 virtual seconds: the r3 version ran 10 at ~6 min wall; the decode
+    # memo + burst delivery + threshold scaling keep this in the default
+    # tier at ~2 min.)
+    assert all(len(s) >= 12 for s in sequences), sorted(len(s) for s in sequences)[:5]
     lengths = sorted(len(s) for s in sequences)
     assert lengths[-1] - lengths[0] <= 8, (lengths[0], lengths[-1])
     # Stake-weighted election actually rotated leaders across the committee.
     leaders = {ref.authority for seq in sequences for ref in seq}
     assert len(leaders) >= 10, sorted(leaders)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("MYSTICETI_BIG_SIMS"),
+    reason="100-authority whole-stack sim: several minutes wall; run with "
+    "MYSTICETI_BIG_SIMS=1 (the driver artifact HUNDRED_r04.json pins it)",
+)
+def test_hundred_nodes_commit(tmp_path):
+    """BASELINE #5-scale committee (100 authorities) through the WHOLE stack
+    on the deterministic simulator: uneven stakes, stake-weighted election,
+    full net_sync/verify/commit path per node.  The reference's sim tier
+    stops at 10 (net_sync.rs:583-781)."""
+    from mysticeti_tpu.committee import (
+        Authority,
+        Committee as C,
+        STAKE_WEIGHTED,
+    )
+
+    n = 100
+    signers = C.benchmark_signers(n)
+    committee = C(
+        [Authority(1 + (i % 3), s.public_key) for i, s in enumerate(signers)],
+        leader_election=STAKE_WEIGHTED,
+    )
+    nodes = run_simulation(
+        _run_nodes(n, str(tmp_path), 5.0, committee=committee), seed=31
+    )
+    sequences = [_committed(node) for node in nodes]
+    _assert_prefix_consistent(sequences)
+    assert all(len(s) >= 6 for s in sequences), sorted(len(s) for s in sequences)[:5]
+    lengths = sorted(len(s) for s in sequences)
+    assert lengths[-1] - lengths[0] <= 8, (lengths[0], lengths[-1])
+    leaders = {ref.authority for seq in sequences for ref in seq}
+    assert len(leaders) >= 15, sorted(leaders)
 
 
 def test_multi_leader_whole_stack(tmp_path):

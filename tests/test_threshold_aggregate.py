@@ -225,6 +225,47 @@ def test_collector_aggregation_rejects_forged_frontier(setup):
     asyncio.run(main())
 
 
+def test_cross_flush_endorsement_skips_late_parent(setup):
+    """Catch-up regime: a block whose quorum of verified children was
+    accepted in EARLIER flushes (peers' streams run at different round
+    offsets) skips its signature dispatch even when it arrives alone."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        blocks = _dag(signers, rounds=3)
+        late = next(b for b in blocks if b.round() == 1 and b.author() == 0)
+        rest = [b for b in blocks if b is not late]
+        assert all(await collector.verify_blocks(rest))
+        dispatched_before = sig.dispatched
+        assert await collector.verify_blocks([late]) == [True]
+        assert sig.dispatched == dispatched_before  # skipped via the index
+        assert collector.aggregated_total >= 1
+
+        # A single-author chain's parent never reaches quorum in the index.
+        solo = CountingSigVerifier()
+        c2 = BatchedSignatureVerifier(
+            committee, solo, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        genesis = [StatementBlock.new_genesis(a) for a in range(4)]
+        parent = StatementBlock.build(
+            1, 1, [g.reference for g in genesis], [Share(b"p")],
+            signer=signers[1],
+        )
+        child = StatementBlock.build(
+            1, 2, [parent.reference], [Share(b"c")], signer=signers[1]
+        )
+        assert all(await c2.verify_blocks([child]))
+        before = solo.dispatched
+        assert await c2.verify_blocks([parent]) == [True]
+        assert solo.dispatched == before + 1  # direct check, no quorum
+
+    asyncio.run(main())
+
+
 def test_collector_aggregation_single_author_stream_never_skips(setup):
     """One peer's own-block push stream (single author) can never reach
     quorum endorsement — every block is verified directly."""

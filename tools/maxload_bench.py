@@ -29,11 +29,13 @@ async def search_one(verifier: str, nodes: int, start_load: int,
 
     if verifier.startswith("tpu"):
         os.environ["INITIAL_DELAY"] = "10"
-        # Node warmup (4 procs sharing one core: jax init + cache loads)
-        # runs ~1-2 min before the first commit; the scrape window must
-        # outlast it plus a steady-state stretch.  tps itself is warmup-
-        # insensitive (benchmark_duration opens at the first committed tx).
-        duration = max(duration, 150.0)
+        # Node warmup (4 procs sharing one core: jax init + persistent-cache
+        # executable loads) runs ~2-3 min before load generators start; the
+        # probe window must outlast it plus a steady-state stretch.  tps
+        # itself is warmup-insensitive (benchmark_duration opens at the
+        # first committed tx), but a window shorter than warmup measures
+        # zero committed tx and the search wrongly bisects down.
+        duration = max(duration, 240.0)
     else:
         os.environ.pop("INITIAL_DELAY", None)
     runner = LocalProcessRunner(
