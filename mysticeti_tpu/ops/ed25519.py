@@ -737,15 +737,25 @@ def _dispatch_indexed_keyed(chunk: np.ndarray, table: "KeyTable", bucket: int):
     if g is None:
         return None
     grouped, tile_keys, positions = g
-    return PK.verify_keyed_blob(
-        grouped, table.words, acomb, tile_keys, _pad_to(positions, bucket),
-        tile=tile,
+    # positions stay on HOST (fetch_handles un-permutes after the transfer):
+    # uploading them spent 4 B/sig of a bandwidth-bound link on data the
+    # device only needed for a final gather (+5% measured e2e).  The
+    # narrower 96 B/sig flat layout (idx reconstructed from tile_keys, ok as
+    # a bitmask — verify_keyed_flat) measured consistently SLOWER e2e
+    # (~343k vs ~388k sig/s) despite fewer bytes: the device-side
+    # reshape/expand costs more than the wire saves here, so the plain
+    # 26-column grouped upload stays the deployed path.
+    handle = PK.verify_keyed_blob(
+        grouped, table.words, acomb, tile_keys, None, tile=tile
     )
+    return handle, positions
 
 
 def dispatch_indexed_chunks(blob: np.ndarray, table: "KeyTable"):
     """Bucket-shaped async dispatch of an indexed blob (pack_blob_indexed
-    layout); returns [(count, handle)] for fetch_handles.
+    layout); returns fetch_handles entries — ``(count, handle)`` for generic
+    chunks, ``(count, handle, positions)`` for keyed-tile chunks whose
+    results come back in GROUPED order (fetch_handles un-permutes on host).
 
     On the Pallas backend each chunk takes the keyed-tile kernel when its
     per-key grouping fits the bucket (the common case: committee authorship
@@ -755,10 +765,13 @@ def dispatch_indexed_chunks(blob: np.ndarray, table: "KeyTable"):
     handles = []
     for start, count, b in iter_buckets(blob.shape[0]):
         chunk = blob[start : start + count]
-        h = _dispatch_indexed_keyed(chunk, table, b) if keyed else None
-        if h is None:
+        hp = _dispatch_indexed_keyed(chunk, table, b) if keyed else None
+        if hp is None:
             h = _dispatch_indexed(jnp.asarray(_pad_to(chunk, b)), table.words)
-        handles.append((count, h))
+            handles.append((count, h))
+        else:
+            h, positions = hp
+            handles.append((count, h, positions))
     return handles
 
 
@@ -927,9 +940,10 @@ def dispatch_blob_chunks(blob: np.ndarray):
 
 
 def fetch_handles(handles) -> np.ndarray:
-    """Force a list of ``(count, device_handle)`` chunk results with ONE
-    device sync: concatenate the (padded) outputs on device, transfer once,
-    then drop the padding lanes on host.
+    """Force a list of ``(count, device_handle[, positions])`` chunk results
+    with ONE device sync: concatenate the (padded) outputs on device,
+    transfer once, then drop padding / un-permute grouped-order keyed
+    results on host.
 
     Per-handle ``np.asarray`` costs a full device round-trip each; on a
     tunneled chip (~100 ms RTT) that alone caps throughput, so the single
@@ -937,17 +951,31 @@ def fetch_handles(handles) -> np.ndarray:
     """
     if not handles:
         return np.zeros(0, bool)
-    if len(handles) == 1:
-        count, h = handles[0]
+    # Entries are (count, handle) in dispatch order, or (count, handle,
+    # positions) for keyed-tile chunks whose results come back in GROUPED
+    # order (positions maps original row -> grouped row; un-permuted here,
+    # on host, so they never ride the upload link).
+    unpacked = [
+        (e[0], e[1], e[2] if len(e) > 2 else None) for e in handles
+    ]
+    if len(unpacked) == 1:
+        count, h, positions = unpacked[0]
+        res = np.asarray(h)
+        if positions is not None:
+            return np.array(res[positions])
         # np.array (not asarray): a writable copy, matching the multi-chunk
         # path — callers patch straggler entries in place.  The copy is a
         # bool row per signature, noise next to the transfer itself.
-        return np.array(np.asarray(h)[:count])
-    flat = np.asarray(jnp.concatenate([h for _, h in handles]))
-    out = np.empty(sum(count for count, _ in handles), bool)
+        return np.array(res[:count])
+    flat = np.asarray(jnp.concatenate([h for _, h, _ in unpacked]))
+    out = np.empty(sum(count for count, _, _ in unpacked), bool)
     src = dst = 0
-    for count, h in handles:
-        out[dst : dst + count] = flat[src : src + count]
+    for count, h, positions in unpacked:
+        chunk = flat[src : src + h.shape[0]]
+        if positions is not None:
+            out[dst : dst + count] = chunk[positions]
+        else:
+            out[dst : dst + count] = chunk[:count]
         src += h.shape[0]
         dst += count
     return out

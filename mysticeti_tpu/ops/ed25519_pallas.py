@@ -579,8 +579,13 @@ def _verify_keyed_pallas_jit(
         k_w.T,
         host_ok[None, :].astype(jnp.int32),
     )
-    # Un-permute back to the caller's order on device (positions maps
-    # original row -> grouped row); padding lanes are dropped by the caller.
+    # Un-permute back to the caller's order on device when positions ride
+    # along (positions maps original row -> grouped row); with
+    # positions=None the (b,) GROUPED-order lanes return as-is and the
+    # caller un-permutes on host — skipping the positions upload entirely
+    # (4 B/sig of a bandwidth-bound tunnel transfer).
+    if positions is None:
+        return out[0].astype(bool)
     return jnp.take(out[0], positions).astype(bool)
 
 
@@ -598,6 +603,71 @@ def _verify_keyed_blob_jit(blob, table, acomb, tile_keys, positions, *, tile, in
     )
 
 
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_keyed_flat_jit(flat, table, acomb, tile_keys, *, tile, interpret):
+    # Wire-minimal keyed dispatch: the grouped layout makes the per-lane key
+    # index REDUNDANT (every lane of a tile shares tile_keys[tile]) and the
+    # host_ok flags compress to one bit per lane, all folded into ONE flat
+    # upload — R||M||s (96 B/sig) + ~0.13 B/sig of mask.  Both the byte
+    # count AND the transfer count matter on the tunnel: each extra array
+    # pays a per-transfer setup comparable to several KB of payload.
+    b = tile_keys.shape[0] * tile
+    blob24 = flat[: b * 24].reshape(b, 24)
+    okmask = flat[b * 24 :]
+    idx = jnp.repeat(
+        tile_keys.astype(jnp.int32), tile, total_repeat_length=b
+    )
+    a_words = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+    msg_words = jnp.concatenate(
+        [blob24[:, :8], a_words, blob24[:, 8:16]], axis=-1
+    )
+    lane = jnp.arange(b)
+    ok = ((okmask[lane // 32] >> (lane % 32)) & 1) != 0
+    _a_y, _a_sign, r_y, r_sign, s_w, k_w, okk = E.prepare_fused(
+        msg_words, blob24[:, 16:24], ok
+    )
+    return _verify_keyed_pallas_jit(
+        tile_keys, acomb, r_y, r_sign, s_w, k_w, okk, None,
+        tile=tile, interpret=interpret,
+    )
+
+
+def verify_keyed_flat(
+    flat,
+    table_words,
+    acomb,
+    tile_keys,
+    *,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Keyed-tile verification of a GROUPED flat upload: b*24 R/M/s words
+    followed by b/32 packed little-bit-order ok words; returns (b,) bool in
+    GROUPED order (callers un-permute on host via the grouping positions)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile is None:
+        tile = default_tile()
+    b = int(tile_keys.shape[0]) * tile
+    if b % 32 != 0:
+        # The ok mask is read as packed 32-lane words; a floor-sized mask
+        # for a ragged tail would alias earlier lanes' bits via the clamped
+        # gather — reject instead.
+        raise ValueError(f"batch {b} not a multiple of 32")
+    if flat.shape[0] != b * 24 + b // 32:
+        raise ValueError(
+            f"flat upload of {flat.shape[0]} words != {b}*24 + {b}//32"
+        )
+    return _verify_keyed_flat_jit(
+        jnp.asarray(flat),
+        jnp.asarray(table_words),
+        jnp.asarray(acomb),
+        jnp.asarray(tile_keys),
+        tile=tile,
+        interpret=interpret,
+    )
+
+
 def verify_keyed_blob(
     grouped,
     table_words,
@@ -610,7 +680,8 @@ def verify_keyed_blob(
 ) -> jnp.ndarray:
     """Keyed-tile fused verification of a GROUPED indexed blob
     (ops.ed25519.group_blob_for_tiles layout).  Returns (b,) bool in the
-    ORIGINAL (pre-grouping) order, padding lanes last."""
+    ORIGINAL (pre-grouping) order, padding lanes last — or, with
+    ``positions=None``, in GROUPED order (the caller un-permutes on host)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if tile is None:
@@ -623,7 +694,7 @@ def verify_keyed_blob(
         jnp.asarray(table_words),
         jnp.asarray(acomb),
         jnp.asarray(tile_keys),
-        jnp.asarray(positions),
+        None if positions is None else jnp.asarray(positions),
         tile=tile,
         interpret=interpret,
     )

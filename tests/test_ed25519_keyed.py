@@ -103,6 +103,42 @@ def test_keyed_kernel_matches_oracle(keyring):
         assert out[i] == oracle
 
 
+def test_keyed_flat_variant_matches_blob(keyring):
+    """verify_keyed_flat (96 B/sig wire variant: key index reconstructed
+    from tile_keys, ok as a packed bitmask, grouped-order output) agrees
+    with verify_keyed_blob on the same grouped batch.  Kept as the option
+    for byte-dominated links; the deployed dispatch uses the 26-column
+    upload (measured faster on this tunnel — see ops/ed25519.py)."""
+    from mysticeti_tpu.ops import ed25519_pallas as PK
+
+    rng, keys = keyring
+    table = E.KeyTable([k.public_key().public_bytes_raw() for k in keys])
+    n, tile, bucket = 24, 8, 64
+    pks, msgs, sigs, expect = _batch(rng, keys, n, tamper_every=5)
+    idx = table.indices_for(pks)
+    blob = E.pack_blob_indexed(idx, msgs, sigs, num_keys=len(table))
+    acomb, valid = table.neg_combs()
+    g = E.group_blob_for_tiles(blob, len(table), tile, bucket)
+    grouped, tile_keys, positions = g
+    okmask = np.packbits(
+        grouped[:, 25].astype(bool), bitorder="little"
+    ).view(np.uint32)
+    flat = np.concatenate([grouped[:, :24].reshape(-1), okmask])
+    out_flat = np.asarray(
+        PK.verify_keyed_flat(
+            flat, table.words, acomb, tile_keys, tile=tile, interpret=True
+        )
+    )
+    out_blob = np.asarray(
+        PK.verify_keyed_blob(
+            grouped, table.words, acomb, tile_keys, None,
+            tile=tile, interpret=True,
+        )
+    )
+    assert (out_flat == out_blob).all()
+    assert (out_flat[positions] == expect).all()
+
+
 def test_keyed_dispatch_end_to_end_forced_pallas(keyring, monkeypatch):
     """verify_batch_table with the backend forced to pallas(interpret) takes
     the keyed dispatch path and still matches expectations, including
