@@ -27,6 +27,7 @@ from .serde import Reader, Writer
 from .types import (
     AuthorityIndex,
     BaseStatement,
+    BlockReference,
     Share,
     StatementBlock,
     TransactionLocator,
@@ -100,6 +101,12 @@ class _LoggingAggregator(TransactionAggregator):
         else:
             super().transaction_processed(k)
 
+    def transaction_processed_range(self, block, start: int, end: int) -> None:
+        if self._log is not None:
+            self._log.log_range(block, start, end)
+        else:
+            super().transaction_processed_range(block, start, end)
+
     def duplicate_transaction(self, k, from_) -> None:
         if self._log is None:
             super().duplicate_transaction(k, from_)
@@ -124,11 +131,13 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         certified_log_path: Optional[str] = None,
         block_store=None,
         metrics=None,
-        transaction_time: Optional[Dict[TransactionLocator, float]] = None,
+        transaction_time: Optional[Dict[BlockReference, float]] = None,
     ) -> None:
         log = TransactionLog.start(certified_log_path) if certified_log_path else None
         self.transaction_votes = _LoggingAggregator(log)
-        self.transaction_time: Dict[TransactionLocator, float] = (
+        # Keyed per OWN proposal block: all shares of a block are drained
+        # at one moment, so one stamp covers the whole run.
+        self.transaction_time: Dict[BlockReference, float] = (
             transaction_time if transaction_time is not None else {}
         )
         self._time_lock = threading.Lock()
@@ -183,18 +192,25 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
                 block, response if require_response else None, self.committee
             )
             if self.metrics is not None and processed:
-                with self._time_lock:
-                    latencies = [
-                        max(0.0, now - created)
-                        for locator in processed
-                        if (created := self.transaction_time.get(locator))
-                        is not None
-                    ]
-                if latencies:
-                    import numpy as np
+                # Certification arrives as ranges; every offset of a run was
+                # proposed together so they share ONE submission timestamp
+                # (transaction_time is keyed per own block).
+                import numpy as np
 
+                lat_values, lat_counts = [], []
+                with self._time_lock:
+                    for rng in processed:
+                        created = self.transaction_time.get(rng.block)
+                        if created is None:
+                            continue
+                        lat_values.append(max(0.0, now - created))
+                        lat_counts.append(
+                            rng.offset_end_exclusive
+                            - rng.offset_start_inclusive
+                        )
+                if lat_values:
                     self.metrics.observe_latency_batch(
-                        "owned", np.asarray(latencies)
+                        "owned", np.repeat(lat_values, lat_counts)
                     )
         if self.metrics is not None:
             self.metrics.block_handler_pending_certificates.set(
@@ -203,12 +219,16 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         return response
 
     def handle_proposal(self, block: StatementBlock) -> None:
-        shared = list(block.shared_transactions())
-        self.pending_transactions -= len(shared)
-        now = time.time()
-        with self._time_lock:
-            for locator, _ in shared:
-                self.transaction_time[locator] = now
+        n_shared = sum(
+            1 for st in block.statements if isinstance(st, Share)
+        )
+        self.pending_transactions -= n_shared
+        if n_shared:
+            # One stamp per OWN proposal: every share of the block was
+            # drained at the same moment, so per-transaction stamps (a dict
+            # entry per tx) carried no information — only cost.
+            with self._time_lock:
+                self.transaction_time[block.reference] = time.time()
         if not self.consensus_only:
             from .committee import shared_ranges
 

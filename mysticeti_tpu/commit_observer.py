@@ -21,7 +21,7 @@ from .block_store import BlockStore
 from .committee import Committee, QUORUM, TransactionAggregator
 from .consensus.linearizer import CommittedSubDag, Linearizer
 from .state import CommitObserverRecoveredState
-from .types import BlockReference, StatementBlock, TransactionLocator
+from .types import BlockReference, StatementBlock
 
 
 class CommitObserver:
@@ -43,7 +43,12 @@ class TestCommitObserver(CommitObserver):
         self,
         block_store: BlockStore,
         committee: Committee,
-        transaction_time: Optional[Dict[TransactionLocator, float]] = None,
+        # Interface parity with commit_observer.rs (which computes shared-tx
+        # latency from this map); HERE latency comes from the 8-byte
+        # timestamp the generator embeds in each transaction, so the map —
+        # keyed per own proposal block since round 4 — is accepted but
+        # never read.
+        transaction_time: Optional[Dict[BlockReference, float]] = None,
         metrics=None,
         handler=None,
         recovered_state: Optional[CommitObserverRecoveredState] = None,

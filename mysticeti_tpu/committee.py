@@ -379,6 +379,26 @@ class TransactionAggregator:
         if self.track_processed and self._nat is None:
             self.processed.add(k)
 
+    def transaction_processed_range(
+        self, block: "BlockReference", start: int, end: int
+    ) -> None:
+        """Range form of the processed hook: certification happens in
+        contiguous runs (often thousands of offsets at default block caps),
+        and building a locator object per offset was a top engine cost at
+        fleet saturation.  Subclasses that only need per-offset semantics
+        keep overriding ``transaction_processed``."""
+        if (
+            type(self).transaction_processed
+            is TransactionAggregator.transaction_processed
+            and (not self.track_processed or self._nat is not None)
+        ):
+            # Base hook would no-op per offset (the native core keeps its
+            # own intervals): skip the per-offset loop entirely.  A subclass
+            # override of the singular hook still sees every offset.
+            return
+        for off in range(start, end):
+            self.transaction_processed(TransactionLocator(block, off))
+
     def _pre_snapshot(self, k: TransactionLocator) -> bool:
         """True when the locator may predate the recovered snapshot — the
         oracles cannot assert what the snapshot did not persist."""
@@ -475,8 +495,12 @@ class TransactionAggregator:
         locator_range: TransactionLocatorRange,
         vote: AuthorityIndex,
         committee: Committee,
-        processed_out: List[TransactionLocator],
+        processed_out: List[TransactionLocatorRange],
     ) -> None:
+        """Tally a vote range; newly certified runs are appended to
+        ``processed_out`` as RANGES (certification is contiguous — a range
+        per certified run instead of a locator per offset keeps the
+        default-cap fast path out of O(transactions) Python loops)."""
         if self._nat is not None:
             block = locator_range.block
             key = self._key(block)
@@ -491,10 +515,8 @@ class TransactionAggregator:
             if retired:
                 self._refs.pop(key, None)
             for s, e in certified:
-                for off in range(s, e):
-                    k = TransactionLocator(block, off)
-                    self.transaction_processed(k)
-                    processed_out.append(k)
+                self.transaction_processed_range(block, s, e)
+                processed_out.append(TransactionLocatorRange(block, s, e))
             self._raise_violations(
                 viol_ranges, block, vote, self.unknown_transaction
             )
@@ -518,10 +540,14 @@ class TransactionAggregator:
                         violations.append(e)
                 return None
             if agg.add(vote, committee):
-                for off in range(sub_start, sub_end):
-                    k = TransactionLocator(locator_range.block, off)
-                    self.transaction_processed(k)
-                    processed_out.append(k)
+                self.transaction_processed_range(
+                    locator_range.block, sub_start, sub_end
+                )
+                processed_out.append(
+                    TransactionLocatorRange(
+                        locator_range.block, sub_start, sub_end
+                    )
+                )
                 return None  # certified: drop from pending
             return agg
 
@@ -540,14 +566,14 @@ class TransactionAggregator:
         block: StatementBlock,
         response: Optional[List[object]],
         committee: Committee,
-    ) -> List[TransactionLocator]:
+    ) -> List[TransactionLocatorRange]:
         """Tally one block's shares and votes (committee.rs:450-482).
 
         Shares register new aggregations (and, if ``response`` is given, emit our own
         VoteRange replies into it); Vote/VoteRange statements are tallied; returns
-        locators newly certified by this block.
+        the locator RANGES newly certified by this block.
         """
-        processed: List[TransactionLocator] = []
+        processed: List[TransactionLocatorRange] = []
         for rng in shared_ranges(block):
             self.register(rng, block.author(), committee)
             if response is not None:

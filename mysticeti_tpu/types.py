@@ -291,6 +291,7 @@ class StatementBlock:
         "epoch",
         "signature",
         "_bytes",
+        "_digest_trusted",
     )
 
     def __init__(
@@ -303,6 +304,7 @@ class StatementBlock:
         epoch: Epoch,
         signature: bytes,
         _bytes: Optional[bytes] = None,
+        _digest_trusted: bool = False,
     ) -> None:
         self.reference = reference
         self.includes = includes
@@ -312,6 +314,13 @@ class StatementBlock:
         self.epoch = epoch
         self.signature = signature
         self._bytes = _bytes
+        # True only on construction paths that DERIVED the reference digest
+        # from the exact cached bytes (from_bytes): re-hashing the same
+        # bytes in verify_structure would compare a hash with itself — at
+        # ~1 GB/s over multi-MB blocks that tautology was a top-3 CPU cost
+        # at fleet saturation.  Externally-assembled instances default to
+        # False and keep the full check.
+        self._digest_trusted = _digest_trusted
 
     # -- constructors --
 
@@ -525,7 +534,7 @@ class StatementBlock:
         ref = BlockReference(authority, round_, digest)
         block = cls(
             ref, tuple(includes), tuple(statements), meta_ns, epoch_marker,
-            epoch, signature, _bytes=bytes(data),
+            epoch, signature, _bytes=bytes(data), _digest_trusted=True,
         )
         if memo is not None:
             if len(memo) >= cls._DECODE_MEMO_CAP:
@@ -568,9 +577,12 @@ class StatementBlock:
         """
         from .threshold_clock import threshold_clock_valid_non_genesis
 
-        data = self.to_bytes()
-        if crypto.blake2b_256(data) != self.reference.digest:
-            raise VerificationError(f"digest mismatch for {self.reference!r}")
+        if not self._digest_trusted:
+            data = self.to_bytes()
+            if crypto.blake2b_256(data) != self.reference.digest:
+                raise VerificationError(
+                    f"digest mismatch for {self.reference!r}"
+                )
         if self.epoch != committee.epoch:
             raise VerificationError(
                 f"block epoch {self.epoch} != committee epoch {committee.epoch}"
