@@ -51,3 +51,24 @@ def test_env_cap_validation(monkeypatch):
         bh._soft_max_from_env()
     monkeypatch.delenv("MYSTICETI_MAX_BLOCK_TX")
     assert bh._soft_max_from_env() == bh.MAX_PROPOSED_PER_BLOCK
+
+
+def test_log_range_matches_per_locator_format(tmp_path):
+    """log_range (bulk certified-log write) emits byte-identical lines to
+    the per-locator log() path — consumers parse one format."""
+    from mysticeti_tpu.log import TransactionLog
+    from mysticeti_tpu.types import StatementBlock, TransactionLocator
+
+    blk = StatementBlock.new_genesis(3)
+    a = TransactionLog.start(str(tmp_path / "a.log"))
+    for off in range(5, 9):
+        a.log(TransactionLocator(blk.reference, off))
+    a.flush()
+    b = TransactionLog.start(str(tmp_path / "b.log"))
+    b.log_range(blk.reference, 5, 9)
+    b.flush()
+    assert (tmp_path / "a.log").read_bytes() == (tmp_path / "b.log").read_bytes()
+    # and the prefix cache stays coherent for a subsequent singular log()
+    b.log(TransactionLocator(blk.reference, 9))
+    b.flush()
+    assert (tmp_path / "b.log").read_text().splitlines()[-1].endswith(",9")
