@@ -157,10 +157,48 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
             )
         )
     try:
+        # Stall watchdog: a wedged accelerator tunnel (observed after
+        # repeated fleet kill cycles — pool session grants exhausted) hangs
+        # workers inside PJRT init OR mid-dispatch forever.  Failing loud
+        # with a clear message beats hanging the driver's whole bench step.
+        # The guard covers every blocking readline: it kills the workers if
+        # no line arrives within the phase's stall limit.
+        import threading
+
+        stall = {
+            "t": time.monotonic(),
+            "limit": float(os.environ.get("BENCH_READY_TIMEOUT_S", "900")),
+        }
+        stop_guard = threading.Event()
+        timed_out = threading.Event()
+
+        def _watchdog():
+            while not stop_guard.wait(5.0):
+                if time.monotonic() - stall["t"] > stall["limit"]:
+                    timed_out.set()
+                    for p in workers:
+                        p.kill()
+                    return
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+
+        def _stalled(phase: str):
+            return RuntimeError(
+                f"accelerator unreachable: no bench worker progress within "
+                f"{stall['limit']:.0f}s during {phase} (wedged tunnel / pool "
+                f"session exhaustion?)"
+            )
+
         for p in workers:
             line = p.stdout.readline().strip()
+            stall["t"] = time.monotonic()
             if line != "READY":
+                if timed_out.is_set():
+                    raise _stalled("warmup")
                 raise RuntimeError(f"worker failed to start: {line!r}")
+        if timed_out.is_set():
+            raise _stalled("warmup")
+        stall["limit"] = float(os.environ.get("BENCH_STALL_TIMEOUT_S", "600"))
         # Best-of with a time budget: the shared tunnel's transfer weather
         # swings minute to minute (BENCH_SAMPLES_*), so after the minimum
         # trials, keep sampling while the budget lasts — each trial is a
@@ -174,13 +212,21 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
             trial < max_trials and time.monotonic() - started < budget_s
         ):
             trial += 1
-            for p in workers:
-                p.stdin.write("GO\n")
-                p.stdin.flush()
+            try:
+                for p in workers:
+                    p.stdin.write("GO\n")
+                    p.stdin.flush()
+            except (BrokenPipeError, OSError):
+                if timed_out.is_set():
+                    raise _stalled("a trial") from None
+                raise
             sigs_total, slowest = 0, 0.0
             for w, p in enumerate(workers):
                 line = p.stdout.readline()
+                stall["t"] = time.monotonic()
                 if not line.strip():
+                    if timed_out.is_set():
+                        raise _stalled("a trial")
                     # Worker died mid-trial (OOM / PJRT client crash): name
                     # it rather than failing on the empty JSON parse.
                     raise RuntimeError(
@@ -193,6 +239,7 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
             best = max(best, sigs_total / slowest)
         return best
     finally:
+        stop_guard.set()
         for p in workers:
             try:
                 p.stdin.close()
