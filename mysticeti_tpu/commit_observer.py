@@ -87,15 +87,40 @@ class TestCommitObserver(CommitObserver):
             self.committed_leaders.append(commit.anchor)
             for block in commit.blocks:
                 if not self.consensus_only:
-                    self.transaction_votes.process_block(block, None, self.committee)
+                    certified = self.transaction_votes.process_block(
+                        block, None, self.committee
+                    )
+                    if certified and self.metrics is not None:
+                        # Certificates completing during commit processing
+                        # (metrics.rs:59 certificate_committed_latency):
+                        # one sample per range, stamped at proposal.
+                        channel = self.metrics.certificate_committed_latency
+                        for rng in certified:
+                            created = self.transaction_time.get(rng.block)
+                            if created is not None:
+                                channel.observe(max(0.0, now - created))
                 if self.metrics is not None:
                     txs.extend(t for _, t in block.shared_transactions())
         if committed and self.metrics is not None:
+            # meta_creation_time_ns is stamped with runtime.timestamp_utc()
+            # (virtual time under the simulator) — the comparison clock must
+            # be the same source, NOT wall time.
+            from .runtime import timestamp_utc
+
+            now_utc = timestamp_utc()
             self.metrics.commit_round.set(committed[-1].anchor.round)
+            self.metrics.sub_dags_per_commit_count.observe(len(committed))
             for commit in committed:
                 self.metrics.committed_leaders_total.labels(
                     str(commit.anchor.authority), "committed"
                 ).inc()
+                self.metrics.blocks_per_commit_count.observe(len(commit.blocks))
+                for block in commit.blocks:
+                    created = block.meta_creation_time_ns
+                    if created:
+                        self.metrics.block_commit_latency.observe(
+                            max(0.0, now_utc - created / 1e9)
+                        )
         if txs:
             self._update_metrics_batch(txs, now)
         return committed
@@ -121,6 +146,7 @@ class TestCommitObserver(CommitObserver):
         latencies = np.maximum(0.0, now - ts)
         latencies[ts == 0.0] = 0.0  # unstamped txs count as zero latency
         self.metrics.observe_latency_batch("shared", latencies)
+        self.metrics.transaction_committed_latency.observe_many(latencies)
 
     def aggregator_state(self) -> bytes:
         return self.transaction_votes.state()

@@ -234,6 +234,18 @@ class Core:
         )
         assert block.includes[0].authority == self.authority
 
+        if self.metrics is not None:
+            # Proposal-shape channels (metrics.rs:64-66): size from the
+            # cached canonical bytes (computed by build), tx = Share runs,
+            # votes = Vote/VoteRange statements.
+            shares = sum(1 for s in statements if isinstance(s, Share))
+            self.metrics.proposed_block_size_bytes.observe(
+                len(block.to_bytes())
+            )
+            self.metrics.proposed_block_transaction_count.observe(shares)
+            self.metrics.proposed_block_vote_count.observe(
+                len(statements) - shares
+            )
         self.threshold_clock.add_block(block.reference, self.committee)
         self.block_handler.handle_proposal(block)
         next_entry = self.pending[0][0] if self.pending else POSITION_MAX

@@ -39,21 +39,72 @@ LATENCY_SQUARED_S = "latency_squared_s"
 
 
 class PreciseHistogram:
-    """Exact-percentile histogram over a bounded sample window (stat.rs:8-100)."""
+    """Exact-percentile histogram over a reporting window (stat.rs:8-100).
 
-    __slots__ = ("samples", "count", "sum", "max_samples")
+    The reference's reporter DRAINS the channel each sweep
+    (metrics.rs:534-601): published percentiles describe the last window,
+    not the whole run.  Same semantics here — ``report_precise`` clears the
+    buffer after publishing.  Within a window the buffer is a uniform
+    reservoir sample (Algorithm R) of every observation, so a window busier
+    than ``max_samples`` still yields representative percentiles instead of
+    freezing on its first ``max_samples`` arrivals (the warmup seconds, the
+    worst possible sample).  ``count``/``sum`` stay cumulative for ``avg``.
+    """
+
+    __slots__ = ("samples", "count", "sum", "max_samples", "_window_count", "_rng")
 
     def __init__(self, max_samples: int = 100_000) -> None:
+        import random
+
         self.samples: List[float] = []
         self.count = 0
         self.sum = 0.0
         self.max_samples = max_samples
+        self._window_count = 0
+        self._rng = random.Random(0xC0FFEE)
 
     def observe(self, value: float) -> None:
         self.count += 1
         self.sum += value
+        self._window_count += 1
         if len(self.samples) < self.max_samples:
             self.samples.append(value)
+        else:
+            # Reservoir (Algorithm R): keep each of the window's n
+            # observations with probability max_samples/n.
+            j = self._rng.randrange(self._window_count)
+            if j < self.max_samples:
+                self.samples[j] = value
+
+    def observe_many(self, values) -> None:
+        """Vectorized observe over a numpy array (the commit path hands us
+        thousands of samples per batch at load): one sum + one batched
+        reservoir step instead of n Python calls."""
+        n = len(values)
+        if n == 0:
+            return
+        self.count += n
+        self.sum += float(values.sum())
+        cap = self.max_samples
+        fill = min(cap - len(self.samples), n)
+        if fill > 0:
+            self.samples.extend(float(v) for v in values[:fill])
+            self._window_count += fill
+            values = values[fill:]
+            n -= fill
+        if n <= 0:
+            return
+        # Algorithm R, batched: the k-th remaining value is the
+        # (window_count + k)-th of the window; it replaces a random slot
+        # with probability cap / (window_count + k).
+        import numpy as np
+
+        idx = np.arange(self._window_count + 1, self._window_count + n + 1)
+        self._window_count += n
+        slots = (np.array([self._rng.randrange(i) for i in idx]))
+        hit = slots < cap
+        for slot, value in zip(slots[hit], np.asarray(values)[hit]):
+            self.samples[slot] = float(value)
 
     def pcts(self, pcts: Sequence[int]) -> Optional[Dict[int, float]]:
         if not self.samples:
@@ -70,6 +121,7 @@ class PreciseHistogram:
 
     def clear(self) -> None:
         self.samples.clear()
+        self._window_count = 0
 
 
 class Metrics:
@@ -120,6 +172,18 @@ class Metrics:
         )
         self.block_store_entries = counter("block_store_entries", "stored blocks")
         self.wal_mappings = gauge("wal_mappings", "live mmap windows")
+        self.wal_size_bytes = gauge(
+            "wal_size_bytes", "write-ahead log file size"
+        )
+
+        # Core owner queue (core_lock_* in metrics.rs:51-53; the dispatcher
+        # queue is this framework's core lock).
+        self.core_lock_enqueued = counter(
+            "core_lock_enqueued", "commands submitted to the core owner"
+        )
+        self.core_lock_dequeued = counter(
+            "core_lock_dequeued", "commands executed by the core owner"
+        )
 
         # Handlers.
         self.block_handler_pending_certificates = gauge(
@@ -137,6 +201,20 @@ class Metrics:
         )
         self.block_sync_requests_failed = counter(
             "block_sync_requests_failed", "refs peers did not have"
+        )
+        self.block_sync_requests_received = counter(
+            "block_sync_requests_received", "sync requests served",
+            labels=("peer",),
+        )
+        self.block_receive_latency = histogram(
+            "block_receive_latency",
+            "proposal-to-receipt latency of peer blocks",
+            labels=("authority",),
+        )
+        self.add_block_latency = histogram(
+            "add_block_latency",
+            "proposal-to-acceptance latency of peer blocks",
+            labels=("authority",),
         )
         self.connected_nodes = gauge("connected_nodes", "live peer connections")
         self.connection_latency = histogram(
@@ -228,13 +306,17 @@ class Metrics:
             )
 
     def report_precise(self) -> None:
-        """One reporter sweep: publish exact percentiles (metrics.rs:534-601)."""
+        """One reporter sweep: publish exact percentiles, then DRAIN
+        (metrics.rs:534-601 — the reference's histogram channel empties per
+        sweep, so gauges track the last window; a quiet window keeps the
+        previous published value)."""
         for name, hist in self._precise.items():
             pcts = hist.pcts((50, 90, 99))
             if pcts is None:
                 continue
             for pct, value in pcts.items():
                 self._pct_gauge.labels(name, str(pct)).set(value)
+            hist.clear()
 
     def expose(self) -> bytes:
         return generate_latest(self.registry)

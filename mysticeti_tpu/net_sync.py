@@ -150,6 +150,8 @@ class NetworkSyncer:
         """Dedicated fsync thread, 1 s cadence (net_sync.rs:496-560)."""
         syncer = self.core.wal_syncer()
         stop = self._stopped
+        size_gauge = self.metrics.wal_size_bytes if self.metrics else None
+        wal_writer = self.core.wal_writer
 
         def run():
             import time as _time
@@ -160,6 +162,10 @@ class NetworkSyncer:
                     syncer.sync()
                 except OSError:
                     return
+                if size_gauge is not None:
+                    # The appender's position is the log's logical size;
+                    # sampled here so the gauge costs one set per second.
+                    size_gauge.set(wal_writer.position())
 
         self._wal_sync_thread = threading.Thread(
             target=run, name="wal-syncer", daemon=True
@@ -254,6 +260,10 @@ class NetworkSyncer:
                             fut.cancel()
                             raise
                 elif isinstance(msg, RequestBlocks):
+                    if self.metrics is not None:
+                        self.metrics.block_sync_requests_received.labels(
+                            str(peer)
+                        ).inc(len(msg.references))
                     await disseminator.send_requested(list(msg.references))
                 elif isinstance(msg, BlockNotFound):
                     if self.metrics is not None:
@@ -338,6 +348,19 @@ class NetworkSyncer:
                     log.warning("rejecting block %r: %s", block.reference, exc)
                     continue
                 verified.append(block)
+        if self.metrics is not None and verified:
+            # Proposal-to-receipt per author (metrics.rs:81
+            # block_receive_latency) — per block, so the cost scales with
+            # block rate, not tx rate.
+            from .runtime import timestamp_utc
+
+            now = timestamp_utc()
+            for block in verified:
+                created = block.meta_creation_time_ns
+                if created:
+                    self.metrics.block_receive_latency.labels(
+                        str(block.author())
+                    ).observe(max(0.0, now - created / 1e9))
         return verified
 
     async def _verify_accepted(
@@ -360,6 +383,16 @@ class NetworkSyncer:
         missing = await self.dispatcher.add_blocks(
             accepted, self.connected_authorities.copy()
         )
+        if self.metrics is not None:
+            from .runtime import timestamp_utc
+
+            now = timestamp_utc()
+            for block in accepted:
+                created = block.meta_creation_time_ns
+                if created:
+                    self.metrics.add_block_latency.labels(
+                        str(block.author())
+                    ).observe(max(0.0, now - created / 1e9))
         if missing:
             # Request missing causal history from the connection that
             # delivered the children — it is the peer most likely to have the
