@@ -208,6 +208,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     o.add_argument("--scrape-interval", type=float, default=10.0)
     o.add_argument("--plot", action="store_true", help="write latency-throughput plot")
 
+    vs = sub.add_parser(
+        "verifier-service",
+        help="shared per-host verifier service: one warmed JAX runtime "
+        "serving every co-located validator over a unix socket "
+        "(set MYSTICETI_VERIFIER_SOCKET on the nodes to use it)",
+    )
+    vs.add_argument("--socket", required=True, help="unix socket path")
+    vs.add_argument("--committee-path", default=None,
+                    help="prewarm for this committee while validators boot")
+
     f = sub.add_parser(
         "fleet",
         help="testbed lifecycle over a host pool: deploy/start/stop/destroy/"
@@ -266,6 +276,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         for i, seq in enumerate(committed):
             print(f"validator {i}: {len(seq)} committed leaders")
         return 0
+    if args.command == "verifier-service":
+        from .verifier_service import run_service
+
+        keys = None
+        if args.committee_path:
+            keys = Committee.load(args.committee_path).public_key_bytes()
+        run_service(args.socket, keys)
+        return 0
     if args.command == "orchestrator":
         return run_orchestrator(args)
     if args.command == "fleet":
@@ -283,8 +301,17 @@ def run_fleet(args) -> int:
 
     settings = Settings.load(args.settings) if args.settings else Settings()
     pool = args.hosts if args.hosts is not None else settings.hosts
-    provider = StaticProvider(pool, state_path=args.state)
-    ssh = SshManager(pool) if pool else None
+    if settings.provider != "static":
+        provider = settings.make_provider(state_path=args.state)
+        if settings.provider == "rest" and args.action == "deploy" and not args.count:
+            raise SystemExit("rest provider: `fleet deploy` requires --count")
+        # The ssh pool comes from the PROVIDER's live instances (a cloud
+        # fleet has no static hosts list); resolved per-action below since
+        # listing is async.
+        ssh = None
+    else:
+        provider = StaticProvider(pool, state_path=args.state)
+        ssh = SshManager(pool) if pool else None
     # settings.remote_repo's "." default addresses the ssh *runner* (commands
     # run from the checkout); as a clone target it would hit $HOME — keep
     # Testbed's own directory default unless the operator set a real path.
@@ -299,6 +326,10 @@ def run_fleet(args) -> int:
     )
 
     async def dispatch() -> None:
+        if settings.provider == "rest" and tb.ssh is None:
+            hosts = [i.host for i in await provider.list_instances() if i.host]
+            if hosts:
+                tb.ssh = SshManager(hosts)
         if args.action == "deploy":
             await tb.deploy(args.count or len(pool), args.region)
         elif args.action == "start":

@@ -186,6 +186,11 @@ class Committee:
     def get_public_key(self, authority: AuthorityIndex) -> crypto.PublicKey:
         return self.authorities[authority].public_key
 
+    def public_key_bytes(self) -> List[bytes]:
+        """Every authority's raw 32-byte key, in index order — the committee
+        table the TPU verifier and the verifier service key on."""
+        return [a.public_key.bytes for a in self.authorities]
+
     def authority_indexes(self) -> range:
         return range(len(self.authorities))
 

@@ -26,6 +26,20 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 import jax
+
+# Some PJRT plugins (the axon TPU tunnel among them) register a backend that
+# wins platform selection even when JAX_PLATFORMS says otherwise; only the
+# config API reliably pins the platform (tests/conftest.py works around the
+# same thing).  Mirror the env var into the config HERE — before any backend
+# is initialized — so subprocesses launched with JAX_PLATFORMS=cpu (fleet
+# verifier services, CI tools) never touch an unavailable accelerator.
+_env_platforms = os.environ.get("JAX_PLATFORMS")
+if _env_platforms and jax.config.jax_platforms != _env_platforms:
+    try:
+        jax.config.update("jax_platforms", _env_platforms)
+    except Exception:  # already initialized: the env var did its job
+        pass
+
 import jax.numpy as jnp
 
 from . import field as F

@@ -78,6 +78,8 @@ class LocalProcessRunner(Runner):
         self.processes: Dict[int, asyncio.subprocess.Process] = {}
         self.parameters: Optional[Parameters] = None
         self._host_sampler = None
+        self._verifier_service: Optional[asyncio.subprocess.Process] = None
+        self._service_socket: Optional[str] = None
 
     async def configure(self, committee_size: int, load_tx_s: int = 0) -> None:
         self.committee_size = committee_size
@@ -99,6 +101,101 @@ class LocalProcessRunner(Runner):
             os.path.join(self.working_dir, "parameters.yaml")
         )
         self._assert_ports_free()
+        if (
+            self.verifier.startswith("tpu")
+            and not os.environ.get("MYSTICETI_NO_VERIFIER_SERVICE")
+        ):
+            await self._start_verifier_service()
+
+    async def _start_verifier_service(self) -> None:
+        """One warmed accelerator runtime for the whole fleet
+        (verifier_service.py): started before the nodes so its trace/compile
+        overlaps their boot; nodes find it via MYSTICETI_VERIFIER_SOCKET and
+        never build a JAX runtime of their own."""
+        if self._verifier_service is not None:
+            return
+        self._service_socket = os.path.join(
+            os.path.abspath(self.working_dir), "verifier.sock"
+        )
+        # A previous run's cleanup SIGKILLs the service, skipping its own
+        # unlink — a stale socket file would satisfy the exists() wait below
+        # before the fresh process has bound it.
+        if os.path.exists(self._service_socket):
+            os.unlink(self._service_socket)
+        log = open(os.path.join(self.working_dir, "verifier-service.log"), "ab")
+        env = dict(os.environ)
+        env.pop("MYSTICETI_VERIFIER_SOCKET", None)  # the service IS the backend
+        self._verifier_service = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "mysticeti_tpu",
+            "verifier-service",
+            "--socket",
+            self._service_socket,
+            "--committee-path",
+            os.path.join(self.working_dir, "committee.yaml"),
+            env=env,
+            stdout=log,
+            stderr=log,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        )
+        try:
+            await self._await_service_warm()
+        except BaseException:
+            # A failed boot must not leak the child: an orphaned service
+            # would hold the accelerator and contend with the next run's
+            # service for the chip.
+            service, self._verifier_service = self._verifier_service, None
+            self._service_socket = None
+            if service is not None and service.returncode is None:
+                service.send_signal(signal.SIGKILL)
+                await service.wait()
+            raise
+
+    async def _await_service_warm(self) -> None:
+        # The socket appears as soon as the listener is up.
+        for _ in range(600):
+            if os.path.exists(self._service_socket):
+                break
+            if self._verifier_service.returncode is not None:
+                raise RuntimeError(
+                    "verifier service died at boot — see verifier-service.log"
+                )
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("verifier service socket never appeared")
+        # Block until the service is WARM (HELLO round-trip), not merely
+        # listening: booting validators early makes them contend for the
+        # host's cores exactly while the service is paying its one-time
+        # trace/compile — on a small host that contention can starve the
+        # warmup for the whole measurement window.  A host daemon being warm
+        # before validators start is also the deployment shape.
+        from ..committee import Committee
+        from ..verifier_service import RemoteSignatureVerifier
+
+        committee = Committee.load(
+            os.path.join(self.working_dir, "committee.yaml")
+        )
+        probe = RemoteSignatureVerifier(
+            socket_path=self._service_socket,
+            committee_keys=committee.public_key_bytes(),
+            timeout_s=900.0,
+        )
+        loop = asyncio.get_running_loop()
+        for _ in range(50):
+            try:
+                await loop.run_in_executor(None, probe.warmup)
+                return
+            except (ConnectionError, OSError):
+                # Bound but briefly unready, or unlink/bind race: retry
+                # while the subprocess is alive.
+                if self._verifier_service.returncode is not None:
+                    raise RuntimeError(
+                        "verifier service died during warmup — see "
+                        "verifier-service.log"
+                    )
+                await asyncio.sleep(0.2)
+        raise RuntimeError("verifier service never became warm")
 
     def _assert_ports_free(self) -> None:
         """Fail fast when another fleet holds our ports: a node that cannot
@@ -132,6 +229,8 @@ class LocalProcessRunner(Runner):
         env["TPS"] = str(self.tps_per_node)
         env["TRANSACTION_SIZE"] = str(self.transaction_size)
         env.setdefault("INITIAL_DELAY", "1")
+        if self._service_socket is not None:
+            env["MYSTICETI_VERIFIER_SOCKET"] = self._service_socket
         log = open(os.path.join(self.working_dir, f"node-{authority}.log"), "ab")
         proc = await asyncio.create_subprocess_exec(
             sys.executable,
@@ -183,6 +282,10 @@ class LocalProcessRunner(Runner):
     async def cleanup(self) -> None:
         for authority in list(self.processes):
             await self.kill_node(authority)
+        service, self._verifier_service = self._verifier_service, None
+        if service is not None and service.returncode is None:
+            service.send_signal(signal.SIGKILL)
+            await service.wait()
 
 
 class SshRunner(Runner):

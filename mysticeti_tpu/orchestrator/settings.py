@@ -26,12 +26,43 @@ class Settings:
     tps_per_node: int = 100
     transaction_size: int = 512
     verifier: str = "cpu"
+    # Testbed provisioning (settings.rs cloud_provider/token_file): "static"
+    # claims hosts from ``hosts``; "rest" provisions via the JSON-REST cloud
+    # client (providers.py).  The API token is read from the env var named
+    # by ``provider_token_env`` so checked-in settings never carry secrets.
+    provider: str = "static"  # "static" | "rest"
+    provider_base_url: str = ""
+    provider_token_env: str = "CLOUD_API_TOKEN"
+    provider_region: str = "ewr"
+    provider_plan: str = "vc2-16c-64gb"
 
     def validate(self) -> None:
         if self.runner not in ("local", "ssh"):
             raise ValueError(f"unknown runner {self.runner!r}")
         if self.runner == "ssh" and not self.hosts:
             raise ValueError("ssh runner requires at least one host")
+        if self.provider not in ("static", "rest"):
+            raise ValueError(f"unknown provider {self.provider!r}")
+        if self.provider == "rest" and not self.provider_base_url:
+            raise ValueError("rest provider requires provider_base_url")
+
+    def make_provider(self, state_path: Optional[str] = None,
+                      transport=None):
+        """Instantiate the configured ServerProvider (testbed.py seam)."""
+        self.validate()
+        if self.provider == "rest":
+            from .providers import RestCloudProvider
+
+            return RestCloudProvider(
+                self.provider_base_url,
+                token=os.environ.get(self.provider_token_env, ""),
+                region=self.provider_region,
+                plan=self.provider_plan,
+                transport=transport,
+            )
+        from .testbed import StaticProvider
+
+        return StaticProvider(self.hosts, state_path=state_path)
 
     def make_runner(self):
         """Instantiate the configured Runner (runner.py)."""

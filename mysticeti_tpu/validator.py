@@ -85,12 +85,20 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
         metrics=metrics, aggregate=aggregate, max_delay_s=window_ms / 1e3
     )
     if kind in ("tpu", "tpu-only"):
-        tpu_backend = TpuSignatureVerifier(
-            committee_keys=[
-                committee.get_public_key(a).bytes
-                for a in range(len(committee))
-            ]
-        )
+        committee_keys = committee.public_key_bytes()
+        if os.environ.get("MYSTICETI_VERIFIER_SOCKET"):
+            # Shared per-host verifier service: the accelerator runtime is a
+            # HOST resource — one warmed PJRT client serving every co-located
+            # validator (verifier_service.py).  This process never imports
+            # jax: boot is import-light and a rebooted node re-attaches to
+            # the warm service instead of re-paying a cold runtime.
+            from .verifier_service import RemoteSignatureVerifier
+
+            tpu_backend = RemoteSignatureVerifier(
+                committee_keys=committee_keys
+            )
+        else:
+            tpu_backend = TpuSignatureVerifier(committee_keys=committee_keys)
         # "tpu" deploys the hybrid dispatch policy (small batches take the
         # CPU oracle, sparing them the accelerator round-trip — SURVEY §7
         # hard part #2); "tpu-only" pins every batch to the kernel, which is

@@ -1,0 +1,381 @@
+"""Shared per-host verifier service: ONE warmed JAX runtime for the fleet.
+
+Round-4 finding: giving every validator process its own JAX runtime
+(``validator.py:_make_verifier``) made the TPU path lose to CPU at fleet
+level — N processes serially paying import + PJRT init + trace/compile on a
+shared host, then N independent connections to the accelerator.  The
+reference never hits this because its verifier is a CPU function in-process
+(``mysticeti-core/src/crypto.rs:174-189``); a TPU-first design wants the
+opposite split: the accelerator runtime is a HOST resource, owned by one
+process, shared by every co-located validator.
+
+  * :class:`VerifierServer` — owns a single :class:`TpuSignatureVerifier`
+    (one PJRT client, one compile cache, warmed once), serves signature
+    batches over a unix-domain socket.  Requests from different validators
+    dispatch concurrently (async device dispatch overlaps their round-trips).
+  * :class:`RemoteSignatureVerifier` — the validator-side
+    :class:`SignatureVerifier` that forwards batches to the service.  It
+    never imports jax: a validator process using it boots import-light, and
+    a REBOOTED validator re-attaches to the still-warm service instead of
+    re-paying a cold runtime (the round-4 catch-up gap: 100 s+ of re-warm).
+
+Wire protocol (little-endian, length-prefixed frames):
+
+  frame    = u32 payload_len | u8 type | payload
+  HELLO    (1)   u16 n_keys | n_keys * 32 B pk      -> HELLO_OK once warm
+  VERIFY   (2)   u32 req_id | u32 n | n * (u16 key_idx | 32 B digest | 64 B sig)
+  RAW      (3)   u32 req_id | u32 n | n * (32 B pk | 32 B digest | 64 B sig)
+  HELLO_OK (128) empty
+  RESULT   (129) u32 req_id | n * u8 ok
+  ERR      (255) utf-8 message (protocol error; connection closes)
+
+HELLO doubles as the warmup gate: the reply is sent only after the backend's
+one-time trace/compile finished, so a client's ``warmup()`` is "send HELLO,
+wait" — seconds against a warm service, never minutes.  All clients must
+present the same committee (one table per service); a mismatch is an ERR.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+from .block_validator import SignatureVerifier
+from .tracing import logger
+
+log = logger(__name__)
+
+T_HELLO = 1
+T_VERIFY = 2
+T_RAW = 3
+T_HELLO_OK = 128
+T_RESULT = 129
+T_ERR = 255
+
+_IDX_REC = 2 + 32 + 64  # u16 idx | digest | sig
+_RAW_REC = 32 + 32 + 64
+
+ENV_SOCKET = "MYSTICETI_VERIFIER_SOCKET"
+
+
+def _frame(type_: int, payload: bytes) -> bytes:
+    return struct.pack("<IB", len(payload), type_) + payload
+
+
+# ---------------------------------------------------------------------------
+# Server
+
+
+class VerifierServer:
+    """One accelerator runtime serving every validator on the host."""
+
+    def __init__(self, socket_path: str, committee_keys: Optional[Sequence[bytes]] = None,
+                 backend=None) -> None:
+        self.socket_path = socket_path
+        self._backend = backend
+        self._keys: Optional[List[bytes]] = (
+            list(committee_keys) if committee_keys else None
+        )
+        self._warmed = threading.Event()
+        self._warm_lock = threading.Lock()
+        # Sized for a 10+ validator fleet: each in-flight request blocks a
+        # worker thread on the device fetch, and overlapping those
+        # round-trips is the entire point of sharing the runtime.
+        self._pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="verify-dispatch"
+        )
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+
+    # -- backend lifecycle --
+
+    def _ensure_backend(self, keys: List[bytes]):
+        # The whole init+warmup runs under the lock: concurrent HELLOs from a
+        # booting fleet must not race two warmups through the JAX tracer —
+        # the losers just block here until the first one finishes (which is
+        # exactly the contract their HELLO wants anyway).
+        with self._warm_lock:
+            if self._keys is None:
+                self._keys = keys
+            elif keys and self._keys != keys:
+                raise ValueError(
+                    "committee mismatch: this verifier service was warmed for "
+                    "a different key set"
+                )
+            if self._backend is None:
+                from .block_validator import TpuSignatureVerifier
+
+                self._backend = TpuSignatureVerifier(committee_keys=self._keys)
+            if not self._warmed.is_set():
+                self._backend.warmup()
+                self._warmed.set()
+            return self._backend
+
+    def prewarm(self) -> None:
+        """Warm before the first client connects (committee known at boot)."""
+        if self._keys is None:
+            raise ValueError("prewarm requires committee keys")
+        self._ensure_backend(self._keys)
+
+    # -- connection handling --
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    header = await reader.readexactly(5)
+                except asyncio.IncompleteReadError:
+                    return
+                length, type_ = struct.unpack("<IB", header)
+                payload = await reader.readexactly(length) if length else b""
+                if type_ == T_HELLO:
+                    (n_keys,) = struct.unpack_from("<H", payload)
+                    keys = [
+                        bytes(payload[2 + 32 * i: 2 + 32 * (i + 1)])
+                        for i in range(n_keys)
+                    ]
+                    try:
+                        await loop.run_in_executor(
+                            self._pool, self._ensure_backend, keys
+                        )
+                    except ValueError as exc:
+                        writer.write(_frame(T_ERR, str(exc).encode()))
+                        await writer.drain()
+                        return
+                    writer.write(_frame(T_HELLO_OK, b""))
+                    await writer.drain()
+                elif type_ in (T_VERIFY, T_RAW):
+                    req_id, n = struct.unpack_from("<II", payload)
+                    body = payload[8:]
+                    rec = _IDX_REC if type_ == T_VERIFY else _RAW_REC
+                    if len(body) != n * rec:
+                        writer.write(_frame(T_ERR, b"malformed verify frame"))
+                        await writer.drain()
+                        return
+                    oks = await loop.run_in_executor(
+                        self._pool, self._verify_payload, type_, n, body
+                    )
+                    writer.write(
+                        _frame(T_RESULT, struct.pack("<I", req_id) + bytes(oks))
+                    )
+                    await writer.drain()
+                else:
+                    writer.write(_frame(T_ERR, b"unknown frame type"))
+                    await writer.drain()
+                    return
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            return
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def _verify_payload(self, type_: int, n: int, body: bytes) -> List[int]:
+        backend = self._ensure_backend(self._keys or [])
+        pks, digests, sigs = [], [], []
+        if type_ == T_VERIFY:
+            keys = self._keys or []
+            for i in range(n):
+                off = i * _IDX_REC
+                (idx,) = struct.unpack_from("<H", body, off)
+                if idx >= len(keys):
+                    # An out-of-range index cannot verify; reject that slot
+                    # rather than the whole batch.
+                    pks.append(bytes(32))
+                else:
+                    pks.append(keys[idx])
+                digests.append(body[off + 2: off + 34])
+                sigs.append(body[off + 34: off + 98])
+        else:
+            for i in range(n):
+                off = i * _RAW_REC
+                pks.append(body[off: off + 32])
+                digests.append(body[off + 32: off + 64])
+                sigs.append(body[off + 64: off + 128])
+        oks = backend.verify_signatures(pks, digests, sigs)
+        return [1 if ok else 0 for ok in oks]
+
+    # -- lifecycle --
+
+    async def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = await asyncio.start_unix_server(
+            self._handle, path=self.socket_path
+        )
+        log.info("verifier service listening on %s", self.socket_path)
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        if self._keys is not None and not self._warmed.is_set():
+            # Warm while validators boot: their HELLOs block until done.
+            await asyncio.get_running_loop().run_in_executor(
+                self._pool, self.prewarm
+            )
+            log.info("verifier service warmed (%d committee keys)",
+                     len(self._keys))
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Sever live client connections first: since 3.12,
+            # ``wait_closed`` waits for every connection HANDLER to finish,
+            # and handlers block in readexactly on idle-but-open clients.
+            for writer in list(self._writers):
+                writer.close()
+            await self._server.wait_closed()
+        self._pool.shutdown(wait=False)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+
+# ---------------------------------------------------------------------------
+# Client
+
+
+class RemoteSignatureVerifier(SignatureVerifier):
+    """Validator-side stub: forwards batches to the host's verifier service.
+
+    jax-free by design — the validator process stays import-light and leans
+    on the service's single warmed runtime.  Called from the batching
+    collector's executor threads: each thread keeps its own connection
+    (``threading.local``) so concurrent flushes pipeline through the service
+    rather than serializing on one socket.
+    """
+
+    backend_label = "tpu-remote"
+
+    def __init__(self, socket_path: Optional[str] = None,
+                 committee_keys: Optional[Sequence[bytes]] = None,
+                 timeout_s: float = 300.0) -> None:
+        self.socket_path = socket_path or os.environ[ENV_SOCKET]
+        self._keys = list(committee_keys or [])
+        self._index = {pk: i for i, pk in enumerate(self._keys)}
+        self.timeout_s = timeout_s
+        self._tls = threading.local()
+
+    # -- socket plumbing --
+
+    def _connect(self) -> socket.socket:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(self.timeout_s)
+        conn.connect(self.socket_path)
+        payload = struct.pack("<H", len(self._keys)) + b"".join(self._keys)
+        conn.sendall(_frame(T_HELLO, payload))
+        type_, reply = self._read_frame(conn)
+        if type_ != T_HELLO_OK:
+            conn.close()
+            raise ConnectionError(
+                f"verifier service rejected hello: {reply.decode(errors='replace')}"
+            )
+        return conn
+
+    def _conn(self) -> socket.socket:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = self._connect()
+            self._tls.conn = conn
+            self._tls.req_id = 0
+        return conn
+
+    @staticmethod
+    def _read_frame(conn: socket.socket):
+        header = b""
+        while len(header) < 5:
+            chunk = conn.recv(5 - len(header))
+            if not chunk:
+                raise ConnectionError("verifier service closed the connection")
+            header += chunk
+        length, type_ = struct.unpack("<IB", header)
+        payload = b""
+        while len(payload) < length:
+            chunk = conn.recv(length - len(payload))
+            if not chunk:
+                raise ConnectionError("verifier service closed mid-frame")
+            payload += chunk
+        return type_, payload
+
+    def _roundtrip(self, frame: bytes, req_id: int) -> bytes:
+        """Send one request; on a stale/broken connection, reconnect ONCE
+        (the service restarting between fleets is normal; a second failure
+        is a real outage and propagates)."""
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.sendall(frame)
+                type_, payload = self._read_frame(conn)
+                break
+            except (ConnectionError, OSError, socket.timeout):
+                self._tls.conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                if attempt:
+                    raise
+        if type_ == T_ERR:
+            raise ConnectionError(
+                f"verifier service error: {payload.decode(errors='replace')}"
+            )
+        assert type_ == T_RESULT
+        (echoed,) = struct.unpack_from("<I", payload)
+        assert echoed == req_id, "verifier service response out of order"
+        return payload[4:]
+
+    # -- SignatureVerifier surface --
+
+    def warmup(self) -> None:
+        """Connect + HELLO: returns once the service's runtime is warm."""
+        self._conn()
+
+    def verify_signatures(self, public_keys, digests, signatures) -> List[bool]:
+        n = len(signatures)
+        if n == 0:
+            return []
+        self._tls.req_id = req_id = getattr(self._tls, "req_id", 0) + 1
+        indices = [self._index.get(pk) for pk in public_keys]
+        if all(i is not None for i in indices) and all(
+            len(d) == 32 for d in digests
+        ):
+            body = b"".join(
+                struct.pack("<H", idx) + digest + sig
+                for idx, digest, sig in zip(indices, digests, signatures)
+            )
+            frame = _frame(
+                T_VERIFY, struct.pack("<II", req_id, n) + body
+            )
+        else:
+            if not all(len(d) == 32 for d in digests):
+                # The service's fixed wire format carries 32-byte digests
+                # (every deployed call site signs blake2b-256); anything else
+                # is a test exotica — verify locally on the CPU oracle.
+                from .block_validator import CpuSignatureVerifier
+
+                return CpuSignatureVerifier().verify_signatures(
+                    public_keys, digests, signatures
+                )
+            body = b"".join(
+                pk + digest + sig
+                for pk, digest, sig in zip(public_keys, digests, signatures)
+            )
+            frame = _frame(T_RAW, struct.pack("<II", req_id, n) + body)
+        oks = self._roundtrip(frame, req_id)
+        assert len(oks) == n
+        return [bool(b) for b in oks]
+
+
+def run_service(socket_path: str, committee_keys: Optional[Sequence[bytes]] = None) -> None:
+    """Blocking entry point for the CLI subcommand."""
+    server = VerifierServer(socket_path, committee_keys=committee_keys)
+    try:
+        asyncio.run(server.serve_forever())
+    except KeyboardInterrupt:
+        pass

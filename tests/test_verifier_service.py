@@ -1,0 +1,238 @@
+"""Shared per-host verifier service: wire protocol, warmup gate, fleet wiring.
+
+The round-4 fleet artifacts showed the cost of one JAX runtime per validator
+process (serial warmups, N accelerator connections); verifier_service.py
+moves the runtime into one host-level process.  These tests drive the unix-
+socket protocol end-to-end with an injected backend (no accelerator needed)
+plus the real TpuSignatureVerifier on the CPU-jax test platform.
+"""
+import asyncio
+import os
+import threading
+
+import pytest
+
+from mysticeti_tpu import crypto
+from mysticeti_tpu.block_validator import CpuSignatureVerifier, SignatureVerifier
+from mysticeti_tpu.verifier_service import (
+    RemoteSignatureVerifier,
+    VerifierServer,
+)
+
+
+class CountingBackend(SignatureVerifier):
+    """CPU oracle + call accounting, to observe dispatch/warmup behavior."""
+
+    def __init__(self) -> None:
+        self.inner = CpuSignatureVerifier()
+        self.warmups = 0
+        self.calls = 0
+
+    def warmup(self) -> None:
+        self.warmups += 1
+
+    def verify_signatures(self, public_keys, digests, signatures):
+        self.calls += 1
+        return self.inner.verify_signatures(public_keys, digests, signatures)
+
+
+def _sigs(n, signers):
+    pks, digests, sigs = [], [], []
+    for i in range(n):
+        signer = signers[i % len(signers)]
+        digest = crypto.blake2b_256(b"payload-%d" % i)
+        pks.append(signer.public_key.bytes)
+        digests.append(digest)
+        sigs.append(signer.sign(digest))
+    return pks, digests, sigs
+
+
+@pytest.fixture()
+def signers():
+    return [crypto.Signer.from_seed(i.to_bytes(32, "little")) for i in range(4)]
+
+
+async def _with_server(tmp_path, committee_keys, backend, fn):
+    server = VerifierServer(
+        str(tmp_path / "verifier.sock"),
+        committee_keys=committee_keys,
+        backend=backend,
+    )
+    await server.start()
+    try:
+        return await fn(server)
+    finally:
+        await server.stop()
+
+
+def test_roundtrip_indexed_and_raw(tmp_path, signers):
+    keys = [s.public_key.bytes for s in signers]
+    backend = CountingBackend()
+
+    async def scenario(server):
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        pks, digests, sigs = _sigs(8, signers)
+        # Corrupt one signature: result order must be preserved.
+        sigs[3] = bytes(64)
+        ok = await asyncio.to_thread(
+            client.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True, True, True, False, True, True, True, True]
+        # A pk OUTSIDE the committee routes through the RAW frame.
+        stranger = crypto.Signer.from_seed(b"\x99" * 32)
+        digest = crypto.blake2b_256(b"raw")
+        ok = await asyncio.to_thread(
+            client.verify_signatures,
+            [stranger.public_key.bytes],
+            [digest],
+            [stranger.sign(digest)],
+        )
+        assert ok == [True]
+        assert backend.calls == 2
+
+    asyncio.run(_with_server(tmp_path, keys, backend, scenario))
+
+
+def test_hello_is_the_warmup_gate_and_runs_once(tmp_path, signers):
+    keys = [s.public_key.bytes for s in signers]
+    backend = CountingBackend()
+
+    async def scenario(server):
+        clients = [
+            RemoteSignatureVerifier(
+                socket_path=server.socket_path, committee_keys=keys
+            )
+            for _ in range(3)
+        ]
+        # Concurrent warmups (a booting fleet): exactly one backend warmup.
+        await asyncio.gather(
+            *(asyncio.to_thread(c.warmup) for c in clients)
+        )
+        assert backend.warmups == 1
+
+    asyncio.run(_with_server(tmp_path, keys, backend, scenario))
+
+
+def test_committee_mismatch_rejected(tmp_path, signers):
+    keys = [s.public_key.bytes for s in signers]
+
+    async def scenario(server):
+        other = crypto.Signer.from_seed(b"\x42" * 32)
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path,
+            committee_keys=[other.public_key.bytes],
+        )
+        with pytest.raises(ConnectionError, match="committee mismatch"):
+            await asyncio.to_thread(client.warmup)
+
+    asyncio.run(_with_server(tmp_path, keys, CountingBackend(), scenario))
+
+
+def test_client_reconnects_after_service_restart(tmp_path, signers):
+    """The service restarting between fleets severs every cached client
+    connection; the next call must transparently reconnect.  A dedicated
+    1-thread executor pins the client to ONE os thread so its thread-local
+    connection is actually reused across the restart."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    keys = [s.public_key.bytes for s in signers]
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        pool = ThreadPoolExecutor(max_workers=1)
+        client = RemoteSignatureVerifier(
+            socket_path=str(tmp_path / "verifier.sock"), committee_keys=keys
+        )
+        pks, digests, sigs = _sigs(2, signers)
+
+        def call():
+            return client.verify_signatures(pks, digests, sigs)
+
+        server1 = VerifierServer(
+            client.socket_path, committee_keys=keys, backend=CountingBackend()
+        )
+        await server1.start()
+        assert await loop.run_in_executor(pool, call) == [True, True]
+        await server1.stop()
+        server2 = VerifierServer(
+            client.socket_path, committee_keys=keys, backend=CountingBackend()
+        )
+        await server2.start()
+        try:
+            assert await loop.run_in_executor(pool, call) == [True, True]
+        finally:
+            await server2.stop()
+            pool.shutdown(wait=False)
+
+    asyncio.run(main())
+
+
+def test_concurrent_clients_share_one_backend(tmp_path, signers):
+    keys = [s.public_key.bytes for s in signers]
+    backend = CountingBackend()
+
+    async def scenario(server):
+        async def one_validator(seed):
+            client = RemoteSignatureVerifier(
+                socket_path=server.socket_path, committee_keys=keys
+            )
+            pks, digests, sigs = _sigs(16, signers)
+            return await asyncio.to_thread(
+                client.verify_signatures, pks, digests, sigs
+            )
+
+        results = await asyncio.gather(*(one_validator(i) for i in range(4)))
+        assert all(all(r) for r in results)
+        assert backend.calls == 4
+
+    asyncio.run(_with_server(tmp_path, keys, backend, scenario))
+
+
+def test_make_verifier_uses_service_when_env_set(tmp_path, signers, monkeypatch):
+    """validator.py:_make_verifier routes tpu kinds through the service —
+    and the validator side never builds its own JAX backend."""
+    from mysticeti_tpu.committee import Committee
+    from mysticeti_tpu.validator import _make_verifier
+
+    committee = Committee.new_for_benchmarks(4)
+    keys = [committee.get_public_key(a).bytes for a in range(4)]
+    backend = CountingBackend()
+
+    async def scenario(server):
+        monkeypatch.setenv("MYSTICETI_VERIFIER_SOCKET", server.socket_path)
+        verifier = _make_verifier("tpu", committee)
+        # ready is set by a warmup thread whose HELLO needs THIS event loop
+        # (the server runs on it) — wait off-loop.
+        assert await asyncio.to_thread(verifier.ready.wait, 30)
+        hybrid = verifier.verifier
+        assert isinstance(hybrid.tpu, RemoteSignatureVerifier)
+        # Hybrid calibration probed the service once (1-sig dispatch).
+        assert backend.calls >= 1
+        only = _make_verifier("tpu-only", committee)
+        assert await asyncio.to_thread(only.ready.wait, 30)
+        assert isinstance(only.verifier, RemoteSignatureVerifier)
+
+    asyncio.run(_with_server(tmp_path, keys, backend, scenario))
+
+
+@pytest.mark.slow
+def test_service_with_real_jax_backend(tmp_path, signers):
+    """Whole stack against the real TpuSignatureVerifier (CPU-jax platform):
+    HELLO triggers the actual trace/compile; verifies stay correct."""
+    keys = [s.public_key.bytes for s in signers]
+
+    async def scenario(server):
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        await asyncio.to_thread(client.warmup)
+        pks, digests, sigs = _sigs(8, signers)
+        sigs[5] = bytes(64)
+        ok = await asyncio.to_thread(
+            client.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True] * 5 + [False] + [True] * 2
+
+    asyncio.run(_with_server(tmp_path, keys, None, scenario))
