@@ -253,3 +253,23 @@ def test_adaptive_batching_window_tracks_dispatch_latency():
         assert v.EMA_OUTLIER_S < 30.0
 
     asyncio.run(main())
+
+
+def test_collection_window_adapts_both_directions():
+    """Round-4 weak #5: the fixed 5 ms window added pure latency at light
+    load when dispatches are sub-ms (hybrid CPU route).  The window is now
+    20% of the dispatch EMA, clamped — wide for remote accelerators, sub-ms
+    for cheap local dispatch, max_delay_s only before calibration."""
+    from mysticeti_tpu.block_validator import BatchedSignatureVerifier
+    from mysticeti_tpu.committee import Committee
+
+    c = BatchedSignatureVerifier(Committee.new_for_benchmarks(4))
+    assert c._effective_delay_s() == c.max_delay_s  # pre-calibration
+    c._dispatch_ema_s = 0.0005  # light-load CPU route
+    assert c._effective_delay_s() == c.MIN_ADAPTIVE_DELAY_S
+    c._dispatch_ema_s = 0.030  # saturated CPU batch
+    assert abs(c._effective_delay_s() - 0.006) < 1e-9
+    c._dispatch_ema_s = 0.100  # tunneled accelerator
+    assert abs(c._effective_delay_s() - 0.020) < 1e-9
+    c._dispatch_ema_s = 10.0  # pathological: stays clamped
+    assert c._effective_delay_s() == c.MAX_ADAPTIVE_DELAY_S

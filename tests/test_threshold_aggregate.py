@@ -350,3 +350,190 @@ def test_validators_commit_with_aggregate_verifier(tmp_path):
                 await v.stop()
 
     asyncio.run(main())
+
+
+def _include_block(signers, author, round_, includes, forge=False):
+    blk = StatementBlock.build(
+        author, round_, includes, [Share(bytes([round_, author]))],
+        signer=signers[author],
+    )
+    if forge:
+        bad = bytes([blk.signature[0] ^ 1]) + blk.signature[1:]
+        blk = StatementBlock(
+            blk.reference, blk.includes, blk.statements,
+            blk.meta_creation_time_ns, blk.epoch_marker, blk.epoch,
+            bad, _bytes=None,
+        )
+    return blk
+
+
+def test_collector_defers_unresolved_to_next_flush(setup):
+    """An interior block whose optimistic endorsement collapses (its
+    endorsers' signatures fail) must NOT trigger a second serialized
+    dispatch in the same flush (the round-4 tpu-agg saturation collapse);
+    it rides the next window and resolves there."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        dispatches = []
+        orig = sig.verify_signatures
+
+        def spy(pks, digests, sigs_):
+            dispatches.append(len(sigs_))
+            return orig(pks, digests, sigs_)
+
+        sig.verify_signatures = spy
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        genesis = [StatementBlock.new_genesis(a).reference for a in range(4)]
+        b = _include_block(signers, 0, 1, genesis)
+        # Three round-2 endorsers of b, two with forged signatures: b is
+        # optimistically quorum-endorsed (3 authors) but actually reaches
+        # only stake 1 — unresolved.
+        children = [
+            _include_block(signers, a, 2, [b.reference], forge=(a in (2, 3)))
+            for a in (1, 2, 3)
+        ]
+        results = await collector.verify_blocks([b] + children)
+        assert results == [True, True, False, False]
+        # Flush 1 dispatched ONLY the frontier (3 children); b deferred and
+        # resolved by its own dispatch in flush 2 — never two serialized
+        # dispatches in one flush.
+        assert dispatches == [3, 1]
+        assert collector.direct_total == 4
+
+    asyncio.run(main())
+
+
+def test_collector_force_dispatches_on_second_deferral(setup):
+    """Liveness guard: a Byzantine author minting fresh forged endorsers
+    every window must not park a block in 'maybe' forever."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        dispatches = []
+        orig = sig.verify_signatures
+
+        def spy(pks, digests, sigs_):
+            dispatches.append(len(sigs_))
+            return orig(pks, digests, sigs_)
+
+        sig.verify_signatures = spy
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=10.0, aggregate=True
+        )
+        # Pin the window so flushes fire ONLY when the test drives them —
+        # the adaptive window would otherwise flush the deferred block
+        # before wave2 arrives.
+        collector._effective_delay_s = lambda: 10.0
+        genesis = [StatementBlock.new_genesis(a).reference for a in range(4)]
+        b = _include_block(signers, 0, 1, genesis)
+        wave1 = [
+            _include_block(signers, a, 2, [b.reference], forge=(a in (2, 3)))
+            for a in (1, 2, 3)
+        ]
+        task = asyncio.ensure_future(collector.verify_blocks([b] + wave1))
+        await asyncio.sleep(0.01)
+        # Flush 1: b is deferred (optimistic quorum via authors {1,2,3},
+        # actual stake 1 once the forged endorsers fail).
+        await collector._flush()
+        assert not task.done()
+        # Fresh forged endorsers arrive in b's second window: optimistic
+        # endorsement again reaches quorum (prior-accepted author 1 + forged
+        # in-batch authors {2,3}) and again collapses — without the guard b
+        # would defer forever.
+        wave2 = [
+            _include_block(signers, a, 3, [b.reference], forge=True)
+            for a in (2, 3)
+        ]
+        task2 = asyncio.ensure_future(collector.verify_blocks(wave2))
+        await asyncio.sleep(0.01)
+        await collector._flush()
+        assert await task == [True, True, False, False]
+        assert await task2 == [False, False]
+        # Flush 1: frontier wave1 (3).  Flush 2: frontier wave2 (2), then
+        # the FORCED direct dispatch for b (1) — deferral is bounded.
+        assert dispatches == [3, 2, 1]
+
+    asyncio.run(main())
+
+
+def test_evicted_endorsement_never_resurrects(setup):
+    """Lemma F, route 2 (docs/aggregate-verification.md): endorsement stake
+    scattered across FIFO evictions must never accumulate to quorum.  Three
+    distinct-author accepted includers of a forged ref exist over the run,
+    but the index is evicted between them — the forged block must be
+    direct-checked (and rejected), not laundered through rebuilt state."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        collector.ENDORSEMENT_MAX_ENTRIES = 2  # force aggressive eviction
+        genesis = [StatementBlock.new_genesis(a).reference for a in range(4)]
+        forged = _include_block(signers, 3, 1, genesis, forge=True)
+
+        # Flush A: genuine accepted blocks by authors 0 and 1 include the
+        # forged ref -> index {0, 1}.
+        wave_a = [
+            _include_block(signers, a, 2, [forged.reference]) for a in (0, 1)
+        ]
+        assert await collector.verify_blocks(wave_a) == [True, True]
+        assert collector._prior_endorsers(forged.reference) == {0, 1}
+
+        # Flush B: unrelated accepted blocks churn the FIFO past its cap —
+        # the forged ref's entry is evicted.
+        filler = _dag(signers, rounds=1)
+        assert all(await collector.verify_blocks(filler))
+        assert collector._prior_endorsers(forged.reference) == frozenset()
+
+        # Flush C: author 2 includes the forged ref -> rebuilt entry is {2}
+        # only; the historical {0, 1} must NOT merge back.
+        wave_c = [_include_block(signers, 2, 2, [forged.reference])]
+        assert await collector.verify_blocks(wave_c) == [True]
+        assert collector._prior_endorsers(forged.reference) == {2}
+
+        # The forged block arrives: total historical endorsers {0,1,2}
+        # would be quorum (3), but only stake 1 is visible — direct check,
+        # rejected.
+        dispatched_before = sig.dispatched
+        results = await collector.verify_blocks([forged])
+        assert results == [False]
+        assert sig.dispatched == dispatched_before + 1
+
+    asyncio.run(main())
+
+
+def test_same_author_endorsement_counts_once(setup):
+    """Lemma F, route 3: one author endorsing a ref both via the cross-flush
+    index and in-batch must count its stake ONCE — quorum must not be
+    reachable by double counting."""
+    committee, signers = setup
+
+    async def main():
+        sig = CountingSigVerifier()
+        collector = BatchedSignatureVerifier(
+            committee, sig, max_batch=64, max_delay_s=0.02, aggregate=True
+        )
+        genesis = [StatementBlock.new_genesis(a).reference for a in range(4)]
+        forged = _include_block(signers, 3, 1, genesis, forge=True)
+        # Prior flush: authors 0 and 1 include the forged ref -> index {0,1}.
+        prior = [
+            _include_block(signers, a, 2, [forged.reference]) for a in (0, 1)
+        ]
+        assert await collector.verify_blocks(prior) == [True, True]
+        # Same batch as the forged block: authors 0 and 1 AGAIN include the
+        # ref.  Double counting would yield stake 4 >= quorum 3; correct
+        # dedup sees {0, 1} = 2 -> direct check -> rejected.
+        again = [
+            _include_block(signers, a, 3, [forged.reference]) for a in (0, 1)
+        ]
+        results = await collector.verify_blocks(again + [forged])
+        assert results == [True, True, False]
+
+    asyncio.run(main())
