@@ -26,15 +26,44 @@ CORE_QUEUE_SIZE = 32
 
 
 class CoreTaskDispatcher:
-    def __init__(self, syncer: Syncer, metrics=None) -> None:
+    # Consecutive command failures (with or without a live caller) after
+    # which the owner halts: a run this long is a persistent fail-stop
+    # condition, not caller churn.
+    MAX_CONSECUTIVE_FAILURES = 16
+
+    def __init__(self, syncer: Syncer, metrics=None,
+                 fatal_handler=None) -> None:
         self.syncer = syncer
         self.metrics = metrics
+        # Called when the owner dies on a persistent failure.  Merely
+        # letting the task die would leave a ZOMBIE: ports held, /metrics
+        # stale, every subsequent command awaiting a reply forever.  The
+        # default terminates the process (the reference's panic posture);
+        # tests inject a recorder.
+        self.fatal_handler = fatal_handler or self._default_fatal
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=CORE_QUEUE_SIZE)
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
+    @staticmethod
+    def _default_fatal() -> None:
+        import os
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGTERM)
+
+    def _on_owner_done(self, task: asyncio.Task) -> None:
+        if self._stopped or task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            log.critical("consensus owner died: %r — invoking fatal handler",
+                         exc)
+            self.fatal_handler()
+
     def start(self) -> "CoreTaskDispatcher":
         self._task = asyncio.ensure_future(self._run())
+        self._task.add_done_callback(self._on_owner_done)
         return self
 
     async def _run(self) -> None:
@@ -43,8 +72,12 @@ class CoreTaskDispatcher:
         # UtilizationTimer instrumentation of the core thread
         # (core.rs/core_thread) — scrapeable as utilization_timer{proc=...}.
         timers = self.metrics.utilization_timer if self.metrics else None
+        consecutive_failures = 0
+        dequeued = self.metrics.core_lock_dequeued if self.metrics else None
         while True:
             command, args, reply = await self._queue.get()
+            if dequeued is not None:
+                dequeued.inc()
             try:
                 if timers is not None:
                     label = getattr(command, "__name__", "other")
@@ -52,9 +85,11 @@ class CoreTaskDispatcher:
                         result = command(*args)
                 else:
                     result = command(*args)
+                consecutive_failures = 0
                 if reply is not None and not reply.done():
                     reply.set_result(result)
             except Exception as e:  # propagate to the caller, keep the loop alive
+                consecutive_failures += 1
                 if reply is not None and not reply.done():
                     reply.set_exception(e)
                 else:
@@ -66,9 +101,24 @@ class CoreTaskDispatcher:
                         "core command %s failed with no live caller",
                         getattr(command, "__name__", command),
                     )
+                if consecutive_failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    # EVERY recent command failed: that is not a transient
+                    # (a cancelled caller, one malformed batch) but a
+                    # persistent fail-stop condition — WAL/state corruption,
+                    # a poisoned store.  Running on, on possibly corrupt
+                    # state, is the one thing a fail-stop consensus node
+                    # must never do; crash loudly instead (ADVICE r4).
+                    log.critical(
+                        "%d consecutive core command failures — halting the "
+                        "consensus owner (fail-stop)",
+                        consecutive_failures,
+                    )
+                    raise
 
     async def _call(self, fn, *args):
         reply: asyncio.Future = asyncio.get_running_loop().create_future()
+        if self.metrics is not None:
+            self.metrics.core_lock_enqueued.inc()
         await self._queue.put((fn, args, reply))
         return await reply
 

@@ -1,0 +1,78 @@
+"""Dispatcher fail-stop semantics (ADVICE r4): transient command failures
+keep the owner loop alive; a persistent run of failures halts the node
+instead of letting it run on possibly-corrupt state."""
+import asyncio
+
+import pytest
+
+from mysticeti_tpu.core_task import CoreTaskDispatcher
+
+
+def _dispatcher(fatal=None):
+    return CoreTaskDispatcher(syncer=None, fatal_handler=fatal).start()
+
+
+def _boom():
+    raise RuntimeError("corrupt state")
+
+
+def test_single_failure_propagates_and_loop_survives():
+    async def scenario():
+        d = _dispatcher()
+        with pytest.raises(RuntimeError, match="corrupt state"):
+            await d._call(_boom)
+        # Loop is still alive and serving.
+        assert await d._call(lambda: 42) == 42
+        assert not d._task.done()
+        d.stop()
+
+    asyncio.run(scenario())
+
+
+def test_success_resets_the_failure_run():
+    async def scenario():
+        d = _dispatcher()
+        for _ in range(CoreTaskDispatcher.MAX_CONSECUTIVE_FAILURES - 1):
+            with pytest.raises(RuntimeError):
+                await d._call(_boom)
+        assert await d._call(lambda: "ok") == "ok"
+        for _ in range(CoreTaskDispatcher.MAX_CONSECUTIVE_FAILURES - 1):
+            with pytest.raises(RuntimeError):
+                await d._call(_boom)
+        assert not d._task.done()
+        d.stop()
+
+    asyncio.run(scenario())
+
+
+def test_persistent_failure_halts_the_owner_and_fires_fatal_handler():
+    fired = []
+
+    async def scenario():
+        d = _dispatcher(fatal=lambda: fired.append(True))
+        for _ in range(CoreTaskDispatcher.MAX_CONSECUTIVE_FAILURES):
+            with pytest.raises(RuntimeError):
+                await d._call(_boom)
+        await asyncio.sleep(0)  # let the owner task finish raising
+        assert d._task.done()
+        with pytest.raises(RuntimeError, match="corrupt state"):
+            d._task.result()
+        await asyncio.sleep(0)  # done-callback runs on the loop
+        # The node must TERMINATE, not zombie on with a dead owner — the
+        # default handler SIGTERMs the process; tests record instead.
+        assert fired == [True]
+
+    asyncio.run(scenario())
+
+
+def test_clean_stop_does_not_fire_fatal_handler():
+    fired = []
+
+    async def scenario():
+        d = _dispatcher(fatal=lambda: fired.append(True))
+        assert await d._call(lambda: 1) == 1
+        d.stop()
+        await asyncio.sleep(0)
+        assert fired == []
+
+    asyncio.run(scenario())

@@ -127,19 +127,38 @@ async def run_one(verifier: str, nodes: int, load: int, down_s: float,
     # Fleet commit cadence + tps over a short steady window.
     r_start = metric(m0, "commit_round")
     c_start = metric(m0, 'latency_s_count{workload="shared"}')
+    window_t0 = time.monotonic()
     await asyncio.sleep(10)
-    m0 = await scrape_parsed(runner, 0)
+    # A single transient scrape failure must not abort the whole verifier
+    # run — retry briefly instead of calling metric(None, ...).
+    _, m0 = await wait_for(
+        lambda: scrape_parsed(runner, 0), timeout_s=30, interval_s=0.5
+    )
+    if m0 is None:
+        await runner.cleanup()
+        result["error"] = "steady-window scrape failed"
+        return result
+    # Divide by the MEASURED window: scrape retries can stretch it past the
+    # nominal 10 s, and dividing by 10 would inflate the degraded runs.
+    window_s = time.monotonic() - window_t0
     r_now = metric(m0, "commit_round")
-    result["steady_rounds_per_s"] = round((r_now - r_start) / 10.0, 1)
+    result["steady_rounds_per_s"] = round((r_now - r_start) / window_s, 1)
     result["steady_tps"] = round(
-        (metric(m0, 'latency_s_count{workload="shared"}') - c_start) / 10.0, 1
+        (metric(m0, 'latency_s_count{workload="shared"}') - c_start)
+        / window_s, 1
     )
 
     victim = nodes - 1
     await runner.kill_node(victim)
     round_at_kill = r_now
     await asyncio.sleep(down_s)
-    m0 = await scrape_parsed(runner, 0)
+    _, m0 = await wait_for(
+        lambda: scrape_parsed(runner, 0), timeout_s=30, interval_s=0.5
+    )
+    if m0 is None:
+        await runner.cleanup()
+        result["error"] = "reboot-backlog scrape failed"
+        return result
     fleet_round_at_reboot = metric(m0, "commit_round")
     result["backlog_rounds"] = int(fleet_round_at_reboot - round_at_kill)
 
@@ -150,7 +169,9 @@ async def run_one(verifier: str, nodes: int, load: int, down_s: float,
         return await scrape_parsed(runner, victim)
 
     elapsed, mv = await wait_for(metrics_up, timeout_s=120, interval_s=0.25)
-    result["reboot_to_metrics_s"] = round(elapsed, 2) if elapsed else None
+    result["reboot_to_metrics_s"] = (
+        round(elapsed, 2) if elapsed is not None else None
+    )
 
     async def first_verify():
         m = await scrape_parsed(runner, victim)
@@ -159,7 +180,6 @@ async def run_one(verifier: str, nodes: int, load: int, down_s: float,
         c = sig_counters(m)
         return c if (c["direct"] + c["skipped"]) > 0 else None
 
-    t_fv = time.monotonic()
     elapsed, _ = await wait_for(first_verify, timeout_s=240, interval_s=0.25)
     result["reboot_to_first_verify_s"] = (
         round(time.monotonic() - t0, 2) if elapsed is not None else None
