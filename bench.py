@@ -90,9 +90,45 @@ def _run_trial(table, pks, msgs, sigs, iters: int) -> float:
     return elapsed
 
 
+def _run_trial_mesh(mesh, table, pks, msgs, sigs, iters: int) -> float:
+    """Multi-chip trial: the committee-indexed blob sharded over the mesh's
+    batch axis (parallel/mesh.py).  Same discipline as ``_run_trial``: every
+    dispatch async, ONE combined fetch after the timed region's dispatches —
+    blocking per iteration would measure iters x link RTT on a remote slice,
+    not throughput."""
+    import jax.numpy as jnp
+
+    from mysticeti_tpu.ops import ed25519 as E
+    from mysticeti_tpu.parallel.mesh import _cached_indexed_kernel
+
+    kernel = _cached_indexed_kernel(mesh)
+    batch = len(sigs)
+    start = time.perf_counter()
+    handles = []
+    for _ in range(iters):
+        idx = table.indices_for(pks)
+        blob = E.pack_blob_indexed(idx, msgs, sigs, num_keys=len(table))
+        for s, count, b in E.iter_buckets(batch):
+            handles.append((
+                count,
+                kernel(
+                    jnp.asarray(E._pad_to(blob[s:s + count], b)), table.words
+                )[0],
+            ))
+    results = E.fetch_handles(handles)
+    elapsed = time.perf_counter() - start
+    assert results.shape[0] == batch * iters and bool(results.all())
+    return elapsed
+
+
 def _worker() -> None:
     """Child-process mode: warm up, then run one timed trial per GO line on
-    stdin, reporting {"sigs": N, "elapsed": s} per trial on stdout."""
+    stdin, reporting {"sigs": N, "elapsed": s} per trial on stdout.
+
+    BENCH_MESH=N (>1) shards every dispatch over an N-device
+    ``jax.sharding.Mesh`` — a real multi-chip slice is a flag away; on a
+    single-chip host it fails loud rather than silently measuring one chip.
+    """
     import numpy as np
 
     from mysticeti_tpu.ops import ed25519 as E
@@ -100,7 +136,34 @@ def _worker() -> None:
     batch = int(os.environ["BENCH_BATCH"])
     iters = int(os.environ["BENCH_WORKER_ITERS"])
     seed = int(os.environ["BENCH_SEED"])
+    mesh_n = int(os.environ.get("BENCH_MESH", "0"))
     table, pks, msgs, sigs = _build_batch(batch, seed)
+    if mesh_n > 1:
+        import jax
+
+        from mysticeti_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices()
+        if len(devices) < mesh_n:
+            raise RuntimeError(
+                f"BENCH_MESH={mesh_n} but only {len(devices)} device(s) "
+                "attached"
+            )
+        mesh = make_mesh(mesh_n, devices=devices[:mesh_n])
+        from mysticeti_tpu.parallel.mesh import sharded_verify_batch_indexed
+
+        ok, total = sharded_verify_batch_indexed(
+            mesh, table, pks, msgs, sigs
+        )  # warm/compile + correctness (psum total checked once)
+        assert int(total) == batch and bool(ok.all())
+        print("READY", flush=True)
+        for line in sys.stdin:
+            if line.strip() != "GO":
+                continue
+            elapsed = _run_trial_mesh(mesh, table, pks, msgs, sigs, iters)
+            print(json.dumps({"sigs": batch * iters, "elapsed": elapsed}),
+                  flush=True)
+        return
     ok = E.verify_batch_table(table, pks, msgs, sigs)  # warm/compile
     assert bool(np.asarray(ok).all()), "benchmark batch must verify"
     print("READY", flush=True)
@@ -126,7 +189,8 @@ def _single_process(batch: int, iters: int, trials: int) -> float:
     return best
 
 
-def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
+def _multi_process(batch: int, iters: int, trials: int, procs: int,
+                   ready_timeout_s: float, stall_timeout_s: float) -> float:
     """Fleet-shaped measurement: ``procs`` workers, synchronized trials.
 
     Per trial, every worker runs iters/procs batches concurrently; the
@@ -142,20 +206,34 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
             "BENCH_WORKER_ITERS": str(per_worker_iters),
         }
     )
-    workers = []
+    import tempfile
+
+    workers, err_files = [], []
     for w in range(procs):
         wenv = dict(env)
         wenv["BENCH_SEED"] = str(w)
+        # Worker stderr goes to a file, not DEVNULL: a deterministic
+        # config error (e.g. BENCH_MESH with too few devices) must reach
+        # the operator, not vanish while the ladder retries it.
+        err = tempfile.TemporaryFile(mode="w+")
+        err_files.append(err)
         workers.append(
             subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)],
                 stdin=subprocess.PIPE,
                 stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL,
+                stderr=err,
                 env=wenv,
                 text=True,
             )
         )
+
+    def _worker_stderr_tail(w: int, limit: int = 800) -> str:
+        try:
+            err_files[w].seek(0)
+            return err_files[w].read()[-limit:]
+        except OSError:
+            return ""
     try:
         # Stall watchdog: a wedged accelerator tunnel (observed after
         # repeated fleet kill cycles — pool session grants exhausted) hangs
@@ -167,7 +245,9 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
 
         stall = {
             "t": time.monotonic(),
-            "limit": float(os.environ.get("BENCH_READY_TIMEOUT_S", "900")),
+            "limit": float(
+                os.environ.get("BENCH_READY_TIMEOUT_S", str(ready_timeout_s))
+            ),
         }
         stop_guard = threading.Event()
         timed_out = threading.Event()
@@ -189,16 +269,22 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
                 f"session exhaustion?)"
             )
 
-        for p in workers:
+        for w, p in enumerate(workers):
             line = p.stdout.readline().strip()
             stall["t"] = time.monotonic()
             if line != "READY":
                 if timed_out.is_set():
                     raise _stalled("warmup")
-                raise RuntimeError(f"worker failed to start: {line!r}")
+                raise RuntimeError(
+                    f"worker {w} failed to start: {line!r}\n"
+                    f"{_worker_stderr_tail(w)}"
+                )
         if timed_out.is_set():
             raise _stalled("warmup")
-        stall["limit"] = float(os.environ.get("BENCH_STALL_TIMEOUT_S", "600"))
+        sys.stderr.write(f"bench: {procs} workers ready\n")
+        stall["limit"] = float(
+            os.environ.get("BENCH_STALL_TIMEOUT_S", str(stall_timeout_s))
+        )
         # Best-of with a time budget: the shared tunnel's transfer weather
         # swings minute to minute (BENCH_SAMPLES_*), so after the minimum
         # trials, keep sampling while the budget lasts — each trial is a
@@ -225,13 +311,24 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
                 line = p.stdout.readline()
                 stall["t"] = time.monotonic()
                 if not line.strip():
+                    # Distinguish a WEDGE (watchdog fired; the accelerator
+                    # session hung) from a worker DEATH (OOM / PJRT crash:
+                    # deterministic, must fail loud, not ship trial 1 as a
+                    # healthy headline).
                     if timed_out.is_set():
+                        if best > 0.0:
+                            # Round-4 lesson: one wedged session must not
+                            # zero completed measurements.
+                            sys.stderr.write(
+                                f"bench: worker {w} wedged on trial "
+                                f"{trial}; emitting best of {trial - 1} "
+                                f"completed trial(s)\n"
+                            )
+                            return best
                         raise _stalled("a trial")
-                    # Worker died mid-trial (OOM / PJRT client crash): name
-                    # it rather than failing on the empty JSON parse.
                     raise RuntimeError(
                         f"bench worker {w} died mid-trial "
-                        f"(exit code {p.poll()})"
+                        f"(exit code {p.poll()})\n{_worker_stderr_tail(w)}"
                     )
                 rec = json.loads(line)
                 sigs_total += rec["sigs"]
@@ -253,6 +350,11 @@ def _multi_process(batch: int, iters: int, trials: int, procs: int) -> float:
                 # the whole fleet unreaped holding the device.
                 p.kill()
                 p.wait()
+        for err in err_files:
+            try:
+                err.close()
+            except OSError:
+                pass
 
 
 def main() -> None:
@@ -265,21 +367,94 @@ def main() -> None:
     trials = int(os.environ.get("BENCH_TRIALS", "4"))
     procs = int(os.environ.get("BENCH_PROCS", "4"))
 
-    if procs <= 1:
+    if procs <= 0:
+        # Debug mode: in-process, no watchdog (a wedge hangs — use >=1).
         value = _single_process(batch, iters, trials)
-    else:
-        value = _multi_process(batch, iters, trials, procs)
+        _emit(value)
+        return
 
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_verifies_per_sec",
-                "value": round(value, 1),
-                "unit": "sig/s",
-                "vs_baseline": round(value / BASELINE_TARGET, 4),
-            }
-        )
-    )
+    # Recovery ladder: a wedged accelerator session (pool exhaustion, a
+    # worker's PJRT client hanging in init) fails one RUNG, not the whole
+    # measurement — respawn with fewer processes and a smaller per-worker
+    # footprint before giving up.  Each rung gets progressively shorter
+    # stall limits so the ladder fits the driver's patience; the final
+    # error only propagates when every rung produced nothing.  (Round-4
+    # lesson: a single wedged session turned the whole round's headline
+    # artifact into rc=1.)
+    ladder = [(procs, batch, 600.0, 420.0)]
+    if procs > 1:
+        ladder.append((max(1, procs // 2), batch, 360.0, 300.0))
+    ladder.append((1, min(batch, max(4096, batch // 4)), 300.0, 240.0))
+    budget_s = float(os.environ.get("BENCH_LADDER_BUDGET_S", "1800"))
+    started = time.monotonic()
+    value, used, last_error = 0.0, None, None
+    for rung, (procs_i, batch_i, ready_s, stall_s) in enumerate(ladder):
+        if rung > 0 and time.monotonic() - started > budget_s:
+            sys.stderr.write("bench: ladder budget exhausted\n")
+            break
+        try:
+            value = _multi_process(batch_i, iters, trials, procs_i,
+                                   ready_timeout_s=ready_s,
+                                   stall_timeout_s=stall_s)
+            used = {"rung": rung, "procs": procs_i, "batch": batch_i}
+            break
+        except (RuntimeError, OSError) as exc:
+            last_error = exc
+            sys.stderr.write(
+                f"bench: rung {rung} ({procs_i} procs, batch {batch_i}) "
+                f"failed: {exc}\n"
+            )
+    if value <= 0.0:
+        raise last_error or RuntimeError("bench produced no measurement")
+
+    if value < BASELINE_TARGET and os.environ.get("BENCH_ACCOUNTING") != "0":
+        # Under target: decompose WHY onto stderr (the driver keeps the
+        # output tail).  The e2e rate on a tunneled chip is
+        # min(kernel rate, link bandwidth / ~100 B per signature); the
+        # probe measures null RTT, host->device bandwidth and kernel-only
+        # time so a bandwidth-capped run is distinguishable from a chip or
+        # pipeline regression.
+        try:
+            probe = subprocess.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "bench_probe.py")],
+                capture_output=True, text=True, timeout=420,
+            )
+            if probe.returncode == 0 and probe.stdout.strip():
+                acct = json.loads(probe.stdout.strip().splitlines()[-1])
+                bw = acct.get("h2d_MBps")
+                if bw:
+                    acct["wire_ceiling_sig_s_at_100B"] = round(
+                        bw * 1e6 / 100.0, 1
+                    )
+                    acct["measured_fraction_of_wire_ceiling"] = round(
+                        value / acct["wire_ceiling_sig_s_at_100B"], 3
+                    )
+                sys.stderr.write(f"bench accounting: {json.dumps(acct)}\n")
+            else:
+                sys.stderr.write(
+                    f"bench accounting probe failed rc={probe.returncode}\n"
+                )
+        except Exception as exc:  # accounting must never break the number
+            sys.stderr.write(f"bench accounting unavailable: {exc!r}\n")
+
+    _emit(value, used)
+
+
+def _emit(value: float, used: dict = None) -> None:
+    record = {
+        "metric": "ed25519_verifies_per_sec",
+        "value": round(value, 1),
+        "unit": "sig/s",
+        "vs_baseline": round(value / BASELINE_TARGET, 4),
+    }
+    if used:
+        # Which ladder rung produced the number: a fallback-rung result
+        # (fewer procs / smaller batch) must be distinguishable from the
+        # full-config measurement in the recorded artifact.
+        record.update(used)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

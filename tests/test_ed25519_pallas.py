@@ -10,6 +10,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernel
+
 from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
 from mysticeti_tpu.ops import ed25519 as E

@@ -6,6 +6,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernel
+
 import jax.numpy as jnp
 
 from cryptography.exceptions import InvalidSignature

@@ -3,6 +3,10 @@
 Every function is checked against plain python-int arithmetic — the same
 oracle discipline as the field/curve kernels (SURVEY §4 tier "crypto-parity").
 """
+import pytest
+
+pytestmark = pytest.mark.kernel
+
 import hashlib
 import random
 

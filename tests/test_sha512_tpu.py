@@ -1,4 +1,8 @@
 """SHA-512 kernel parity with hashlib over the 96-byte (R||A||M) block shape."""
+import pytest
+
+pytestmark = pytest.mark.kernel
+
 import hashlib
 import random
 
