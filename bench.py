@@ -398,7 +398,9 @@ def main() -> None:
                                    stall_timeout_s=stall_s)
             used = {"rung": rung, "procs": procs_i, "batch": batch_i}
             break
-        except (RuntimeError, OSError) as exc:
+        except (RuntimeError, OSError, ValueError) as exc:
+            # ValueError covers json.JSONDecodeError from a worker dying
+            # mid-print — that too must fall to the next rung, not exit.
             last_error = exc
             sys.stderr.write(
                 f"bench: rung {rung} ({procs_i} procs, batch {batch_i}) "

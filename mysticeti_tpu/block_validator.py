@@ -173,36 +173,37 @@ def _update_ema(current: float, sample: float, outlier_s: float) -> float:
 
 
 class HybridSignatureVerifier(SignatureVerifier):
-    """Route small batches to the CPU oracle, large ones to the TPU kernel
-    (SURVEY §7 hard part #2: "CPU fallback for stragglers").
+    """Route each batch to the CPU oracle or the TPU backend by MEASURED
+    cost (SURVEY §7 hard part #2: "CPU fallback for stragglers").
 
-    A TPU dispatch pays a fixed round-trip (µs co-located, ~100 ms over a
-    tunnel) regardless of batch size, so below some batch size the serial CPU
-    verify finishes before the accelerator round-trip would.  Both sides of
-    the crossover are *measured*, not assumed:
+    The accelerator's cost model has TWO measured parameters, not one:
 
-    * ``cpu_per_sig_s`` — EMA of per-signature CPU cost, seeded by a warmup
-      calibration over real signatures, updated on every CPU-routed dispatch;
-    * ``tpu_dispatch_s`` — EMA of whole-dispatch TPU latency, seeded by a
-      post-compile probe dispatch, updated on every TPU-routed dispatch.
+    * ``tpu_dispatch_s`` — the fixed per-dispatch cost (µs co-located,
+      ~100 ms over a tunnel), seeded by a 1-signature probe after warmup;
+    * ``tpu_per_sig_s`` — the marginal per-signature cost, learned from
+      live TPU-routed dispatches (``max(0, (t - fixed) / n)``).
 
-    The routing threshold is ``tpu_dispatch_s / cpu_per_sig_s`` (the batch
-    size at which CPU time equals one accelerator round-trip), additionally
-    capped so a CPU-routed batch never occupies the host for more than
-    ``MAX_CPU_BUDGET_S``: on a box where the engine shares the core with the
-    verifier, winning the latency race by stealing the core from consensus
-    is a false economy (a 100 ms tunnel RTT would otherwise push the
-    crossover past the collector's own max_batch and starve the TPU path
-    entirely at saturation).
+    A fixed-only model routed saturation batches to "accelerators" that are
+    actually slower per signature than the oracle — on a host whose JAX
+    backend degraded to CPU, a 256-batch "offload" cost 1.5 s where the
+    oracle takes 32 ms, and light-load fleet latency collapsed to ~2 s
+    (round-5 NODE_BENCH draft).  Routing per batch of size n:
+
+    1. ``tpu_time(n) <= cpu_time(n)``       -> TPU (genuinely faster);
+    2. ``cpu_time(n) > MAX_CPU_BUDGET_S``   -> TPU **iff**
+       ``tpu_time(n) <= MAX_OFFLOAD_LATENCY_S`` — offloading frees the
+       host core for the engine (worth paying bounded extra latency on an
+       engine-bound fleet), but never to a backend whose turnaround would
+       itself stall consensus;
+    3. otherwise                            -> CPU.
     """
 
-    DEFAULT_THRESHOLD = 32  # until both EMAs are seeded
+    DEFAULT_THRESHOLD = 32  # n-based routing until both sides are seeded
     MAX_CPU_BUDGET_S = 0.010  # max host time one CPU-routed batch may take
-    # Hard ceiling below the batching collector's max_batch (256): however
-    # fast the CPU measures, collector-full batches must still reach the
-    # accelerator, or a fast core turns "--verifier tpu" into a pure CPU
-    # verifier and the TPU EMA goes stale.
-    MAX_THRESHOLD = 192
+    # Offload-to-free-the-core is only sane when the accelerator turnaround
+    # is itself consensus-compatible: a tunneled chip (~150 ms) qualifies, a
+    # degraded jax-CPU backend (seconds per dispatch) must not.
+    MAX_OFFLOAD_LATENCY_S = 0.5
     EMA_OUTLIER_S = 5.0  # ignore one-time compile stalls
 
     def __init__(
@@ -215,7 +216,8 @@ class HybridSignatureVerifier(SignatureVerifier):
         self.cpu = cpu or CpuSignatureVerifier()
         self._fixed_threshold = threshold
         self.cpu_per_sig_s = 0.0
-        self.tpu_dispatch_s = 0.0
+        self.tpu_dispatch_s = 0.0  # fixed component
+        self.tpu_per_sig_s = 0.0  # marginal component
         # EMA read-modify-writes happen from executor threads; serialize them.
         self._ema_lock = threading.Lock()
         # Routing label of the dispatch that ran in THIS thread: the batching
@@ -229,21 +231,56 @@ class HybridSignatureVerifier(SignatureVerifier):
     def backend_label(self) -> str:
         return getattr(self._tls, "label", "hybrid")
 
+    def _tpu_time(self, n: int) -> float:
+        return self.tpu_dispatch_s + n * self.tpu_per_sig_s
+
+    def _route_to_tpu(self, n: int) -> bool:
+        if self._fixed_threshold is not None:
+            return n >= self._fixed_threshold
+        if not (self.cpu_per_sig_s > 0.0 and self.tpu_dispatch_s > 0.0):
+            return n >= self.DEFAULT_THRESHOLD
+        cpu_t = n * self.cpu_per_sig_s
+        tpu_t = self._tpu_time(n)
+        if tpu_t <= cpu_t:
+            return True
+        return (
+            cpu_t > self.MAX_CPU_BUDGET_S
+            and tpu_t <= self.MAX_OFFLOAD_LATENCY_S
+        )
+
+    # threshold() sentinel: no batch size is currently routed to the
+    # accelerator (degraded backend).
+    NEVER = 1 << 32
+
     def threshold(self) -> int:
+        """Smallest batch size currently routed to the accelerator
+        (introspection/logging; routing itself is per-batch).  Closed form
+        over the two linear cost models — routes agree with
+        ``_route_to_tpu`` by construction."""
+        import math
+
         if self._fixed_threshold is not None:
             return self._fixed_threshold
         if not (self.cpu_per_sig_s > 0.0 and self.tpu_dispatch_s > 0.0):
             return self.DEFAULT_THRESHOLD
-        crossover = self.tpu_dispatch_s / self.cpu_per_sig_s
-        budget_cap = self.MAX_CPU_BUDGET_S / self.cpu_per_sig_s
-        return max(1, min(int(min(crossover, budget_cap)), self.MAX_THRESHOLD))
+        best = self.NEVER
+        # Rule 1: tpu genuinely faster from the speed crossover on.
+        denom = self.cpu_per_sig_s - self.tpu_per_sig_s
+        if denom > 0.0:
+            best = max(1, math.ceil(self.tpu_dispatch_s / denom))
+        # Rule 2: smallest over-budget batch, if the offload is sane there.
+        n_budget = int(self.MAX_CPU_BUDGET_S / self.cpu_per_sig_s) + 1
+        if self._tpu_time(n_budget) <= self.MAX_OFFLOAD_LATENCY_S:
+            best = min(best, n_budget)
+        return best
 
     def warmup(self) -> None:
         from . import crypto
 
         self.tpu.warmup()  # trace/compile (or persistent-cache load)
         # Probe dispatch AFTER the compile: measures the steady-state
-        # accelerator round-trip, not the one-time trace.
+        # accelerator round-trip (the FIXED cost component), not the
+        # one-time trace.
         signer = crypto.Signer.dummy()
         digest = crypto.blake2b_256(b"hybrid-warmup")
         sig = signer.sign(digest)
@@ -263,8 +300,8 @@ class HybridSignatureVerifier(SignatureVerifier):
             self.tpu_dispatch_s = tpu_probe
             self.cpu_per_sig_s = cpu_probe
         log.info(
-            "hybrid verifier calibrated: tpu dispatch %.1f ms, cpu %.0f µs/sig"
-            " -> threshold %d",
+            "hybrid verifier calibrated: tpu dispatch %.1f ms fixed, cpu "
+            "%.0f µs/sig -> tpu from batch %d",
             1e3 * self.tpu_dispatch_s,
             1e6 * self.cpu_per_sig_s,
             self.threshold(),
@@ -274,7 +311,7 @@ class HybridSignatureVerifier(SignatureVerifier):
         n = len(signatures)
         if n == 0:
             return []
-        if n < self.threshold():
+        if not self._route_to_tpu(n):
             started = time.monotonic()
             out = self.cpu.verify_signatures(public_keys, digests, signatures)
             sample = (time.monotonic() - started) / n
@@ -288,9 +325,24 @@ class HybridSignatureVerifier(SignatureVerifier):
         out = self.tpu.verify_signatures(public_keys, digests, signatures)
         sample = time.monotonic() - started
         with self._ema_lock:
-            self.tpu_dispatch_s = _update_ema(
-                self.tpu_dispatch_s, sample, self.EMA_OUTLIER_S
-            )
+            if sample < self.EMA_OUTLIER_S:
+                # Co-adapt BOTH cost parameters toward the residual each
+                # leaves under the other's current estimate: the fixed
+                # component can rise as well as fall (a tunnel settling
+                # slower than its warmup probe must not get its whole rise
+                # misattributed to per-signature cost, which would wrongly
+                # veto the saturation offload), and observations at varied
+                # batch sizes disambiguate the split over time.
+                implied_fixed = max(0.0, sample - n * self.tpu_per_sig_s)
+                implied_marginal = max(
+                    0.0, (sample - self.tpu_dispatch_s) / n
+                )
+                self.tpu_dispatch_s = _update_ema(
+                    self.tpu_dispatch_s, implied_fixed, self.EMA_OUTLIER_S
+                )
+                self.tpu_per_sig_s = _update_ema(
+                    self.tpu_per_sig_s, implied_marginal, self.EMA_OUTLIER_S
+                )
         self._tls.label = "hybrid-tpu"
         return out
 

@@ -160,9 +160,10 @@ def test_hybrid_verifier_routes_by_batch_size():
     # Pretend calibration: 100 ms accelerator round-trip, 100 µs/sig CPU.
     hybrid.tpu_dispatch_s = 0.100
     hybrid.cpu_per_sig_s = 100e-6
-    # Crossover would be 1000, but the CPU budget (10 ms) caps it at 100 so
-    # saturation-sized batches still reach the accelerator.
-    assert hybrid.threshold() == 100
+    # Pure-speed crossover would be 1000, but past the CPU budget (10 ms,
+    # i.e. >100 sigs) batches offload to free the host core — the
+    # accelerator's 100 ms turnaround is within MAX_OFFLOAD_LATENCY_S.
+    assert hybrid.threshold() == 101
 
     args = lambda n: ([b"\0" * 32] * n, [b"\1" * 32] * n, [b"\2" * 64] * n)
     hybrid.verify_signatures(*args(5))
@@ -273,3 +274,27 @@ def test_collection_window_adapts_both_directions():
     assert abs(c._effective_delay_s() - 0.020) < 1e-9
     c._dispatch_ema_s = 10.0  # pathological: stays clamped
     assert c._effective_delay_s() == c.MAX_ADAPTIVE_DELAY_S
+
+
+def test_hybrid_never_offloads_to_a_degraded_backend():
+    """Round-5 NODE_BENCH finding: a host whose JAX backend degraded to CPU
+    measures seconds per dispatch — the budget-relief offload must refuse it
+    (light-load latency collapsed ~25x when it didn't)."""
+    from mysticeti_tpu.block_validator import HybridSignatureVerifier
+
+    h = HybridSignatureVerifier()
+    h.cpu_per_sig_s = 125e-6
+    h.tpu_dispatch_s = 1.5  # degraded: pad-to-bucket on jax-CPU
+    # 256 sigs: 32 ms of CPU is over budget, but 1.5 s of "accelerator"
+    # would stall consensus -> stay on the oracle.
+    assert not h._route_to_tpu(256)
+    assert not h._route_to_tpu(4096)
+    # A real accelerator (tunneled ~150 ms fixed) takes the same batch.
+    h.tpu_dispatch_s = 0.150
+    assert h._route_to_tpu(256)
+    # ...unless its LEARNED marginal cost makes the turnaround stall-grade.
+    h.tpu_per_sig_s = 0.005
+    assert not h._route_to_tpu(256)
+    # Light load always stays local either way.
+    h.tpu_per_sig_s = 0.0
+    assert not h._route_to_tpu(3)

@@ -144,3 +144,116 @@ def test_wal_replay_prefix_under_truncation(
         if pos + HEADER_SIZE + len(payload) <= cut
     ]
     assert replayed == expect
+
+
+@given(data=st.data())
+@settings(max_examples=120, deadline=None)
+def test_native_and_python_decoders_agree(data):
+    """Differential: the C++ decode_block and the interpreter fast path must
+    accept exactly the same frames and produce identical components — on
+    well-formed blocks with every statement kind, and on mutated bytes."""
+    import mysticeti_tpu.types as types_mod
+    from mysticeti_tpu.types import (
+        BlockReference,
+        TransactionLocator,
+        TransactionLocatorRange,
+        Vote,
+        VoteRange,
+    )
+
+    if types_mod._native_decode is None:
+        pytest.skip("native extension unavailable")
+
+    def rand_locator(d):
+        ref = BlockReference(
+            d.draw(st.integers(0, 3)), d.draw(st.integers(1, 50)),
+            d.draw(st.binary(min_size=32, max_size=32)),
+        )
+        return TransactionLocator(ref, d.draw(st.integers(0, 1000)))
+
+    statements = []
+    for _ in range(data.draw(st.integers(0, 6))):
+        kind = data.draw(st.sampled_from(["share", "vote", "reject", "range"]))
+        if kind == "share":
+            statements.append(Share(data.draw(st.binary(max_size=120))))
+        elif kind == "vote":
+            statements.append(Vote(rand_locator(data), True, None))
+        elif kind == "reject":
+            conflict = (
+                rand_locator(data)
+                if data.draw(st.booleans())
+                else None
+            )
+            statements.append(Vote(rand_locator(data), False, conflict))
+        else:
+            ref = rand_locator(data).block
+            s = data.draw(st.integers(0, 500))
+            statements.append(
+                VoteRange(TransactionLocatorRange(
+                    ref, s, s + data.draw(st.integers(0, 500))
+                ))
+            )
+    block = StatementBlock.build(
+        data.draw(st.integers(0, 3)), data.draw(st.integers(1, 1000)),
+        GENESIS, statements, signer=SIGNERS[0],
+    )
+    raw = bytearray(block.to_bytes())
+    if data.draw(st.booleans()):  # half the cases: mutate
+        mode = data.draw(st.sampled_from(["truncate", "trailing", "flip"]))
+        if mode == "truncate":
+            raw = raw[: data.draw(st.integers(0, len(raw) - 1))]
+        elif mode == "trailing":
+            raw += data.draw(st.binary(min_size=1, max_size=8))
+        else:
+            pos = data.draw(st.integers(0, len(raw) - 1))
+            raw[pos] ^= 1 << data.draw(st.integers(0, 7))
+    raw = bytes(raw)
+
+    def decode(force_python):
+        saved = types_mod._native_decode
+        if force_python:
+            types_mod._native_decode = None
+        try:
+            return ("ok", StatementBlock.from_bytes(raw))
+        except (SerdeError, ValueError, OverflowError) as exc:
+            return ("err", type(exc).__name__)
+        finally:
+            types_mod._native_decode = saved
+
+    native = decode(force_python=False)
+    python = decode(force_python=True)
+    assert native[0] == python[0], (native, python)
+    if native[0] == "ok":
+        a, b = native[1], python[1]
+        assert a.reference == b.reference
+        assert a.includes == b.includes
+        assert a.statements == b.statements
+        assert (a.meta_creation_time_ns, a.epoch_marker, a.epoch,
+                a.signature) == (
+            b.meta_creation_time_ns, b.epoch_marker, b.epoch, b.signature)
+
+
+def test_forged_huge_counts_rejected_without_allocation():
+    """A 24-byte frame claiming 2^32-1 includes/statements must be rejected
+    by bounds checks BEFORE any proportional allocation (native decoder DoS
+    guard), and identically by both decoders."""
+    import struct as _struct
+
+    import mysticeti_tpu.types as types_mod
+
+    for which in ("includes", "statements"):
+        if which == "includes":
+            frame = _struct.pack("<QQI", 0, 1, 0xFFFFFFFF) + b"\0" * 4
+        else:
+            frame = _struct.pack("<QQI", 0, 1, 0) + _struct.pack(
+                "<I", 0xFFFFFFFF
+            )
+        for force_python in (False, True):
+            saved = types_mod._native_decode
+            if force_python:
+                types_mod._native_decode = None
+            try:
+                with pytest.raises((SerdeError, ValueError)):
+                    StatementBlock.from_bytes(frame)
+            finally:
+                types_mod._native_decode = saved
