@@ -278,16 +278,21 @@ class HybridSignatureVerifier(SignatureVerifier):
         from . import crypto
 
         self.tpu.warmup()  # trace/compile (or persistent-cache load)
-        # Probe dispatch AFTER the compile: measures the steady-state
-        # accelerator round-trip (the FIXED cost component), not the
-        # one-time trace.
         signer = crypto.Signer.dummy()
         digest = crypto.blake2b_256(b"hybrid-warmup")
         sig = signer.sign(digest)
         pk = signer.public_key.bytes
-        started = time.monotonic()
-        self.tpu.verify_signatures([pk], [digest], [sig])
-        tpu_probe = time.monotonic() - started
+        # Accelerator cost model: prefer the BACKEND's own calibration (the
+        # verifier service measures its warmed dispatch once and shares it
+        # with every client over HELLO_OK) — N co-located validators each
+        # probing a shared service would serialize N dispatches behind boot
+        # contention.  A local backend without one gets the probe dispatch.
+        calibrate = getattr(self.tpu, "dispatch_calibration", None)
+        provided = calibrate() if calibrate is not None else None
+        if provided is None:
+            started = time.monotonic()
+            self.tpu.verify_signatures([pk], [digest], [sig])
+            provided = (time.monotonic() - started, 0.0)
         started = time.monotonic()
         reps = 32
         self.cpu.verify_signatures([pk] * reps, [digest] * reps, [sig] * reps)
@@ -297,12 +302,13 @@ class HybridSignatureVerifier(SignatureVerifier):
         # calibration writes must join the same lock or a concurrent RMW
         # that read the pre-warmup value could land after and discard them.
         with self._ema_lock:
-            self.tpu_dispatch_s = tpu_probe
+            self.tpu_dispatch_s, self.tpu_per_sig_s = provided
             self.cpu_per_sig_s = cpu_probe
         log.info(
-            "hybrid verifier calibrated: tpu dispatch %.1f ms fixed, cpu "
-            "%.0f µs/sig -> tpu from batch %d",
+            "hybrid verifier calibrated: tpu %.1f ms fixed + %.1f µs/sig, "
+            "cpu %.0f µs/sig -> tpu from batch %d",
             1e3 * self.tpu_dispatch_s,
+            1e6 * self.tpu_per_sig_s,
             1e6 * self.cpu_per_sig_s,
             self.threshold(),
         )

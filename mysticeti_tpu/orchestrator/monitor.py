@@ -190,6 +190,97 @@ class MonitoringStack:
             return False
         return True
 
+    # -- remote deployment (monitor.rs:60-105,184) --
+
+    PROM_SESSION = "mysticeti-prometheus"
+    GRAFANA_SESSION = "mysticeti-grafana"
+
+    async def deploy_remote(
+        self,
+        ssh,
+        host: str,
+        metric_targets: List[str],
+        remote_dir: str = "/tmp/mysticeti-monitoring",
+    ) -> str:
+        """Deploy the stack onto a DEDICATED monitoring instance over ssh —
+        the reference configures and (re)starts prometheus + grafana on its
+        monitoring instance through the ssh manager (monitor.rs:60-105; the
+        grafana address accessor :184).  The locally generated tree (scrape
+        config + dashboard provisioning) is uploaded verbatim; both services
+        run as background sessions so `kill_session` tears them down.
+        Returns the grafana URL on the monitoring host.
+        """
+        from .ssh import CommandContext
+
+        self.deploy(metric_targets)
+        await ssh.upload(
+            host,
+            [
+                os.path.join(self.out_dir, "prometheus.yaml"),
+                os.path.join(self.out_dir, "grafana"),
+            ],
+            remote_dir,
+        )
+        # The generated dashboard provider points at the container path
+        # (/etc/grafana/dashboards); retarget it to the uploaded tree the
+        # same way the local launcher does.
+        provider = f"{remote_dir}/grafana/provisioning/dashboards/provider.yaml"
+        await ssh.execute(
+            host,
+            f"sed -i 's#/etc/grafana/dashboards#{remote_dir}/grafana/"
+            f"dashboards#' {provider}",
+        )
+        # Restart semantics: kill any previous sessions, then start fresh
+        # against the uploaded config (monitor.rs re-runs its setup command
+        # list on every deploy).
+        await ssh.kill_session(host, self.PROM_SESSION)
+        await ssh.execute(
+            host,
+            f"prometheus --config.file={remote_dir}/prometheus.yaml"
+            f" --storage.tsdb.path={remote_dir}/tsdb"
+            f" --web.listen-address=0.0.0.0:{PROMETHEUS_PORT}",
+            CommandContext(
+                background=self.PROM_SESSION,
+                log_file=f"{remote_dir}/prometheus.log",
+            ),
+        )
+        await ssh.kill_session(host, self.GRAFANA_SESSION)
+        # Same binary-name and homepath tolerance as the local launcher
+        # (grafana-server on older installs; GF_PATHS_HOME when a
+        # conventional install dir exists).
+        grafana_cmd = (
+            f"GF_PATHS_PROVISIONING={remote_dir}/grafana/provisioning"
+            f" GF_PATHS_DATA={remote_dir}/grafana/data"
+            f" GF_SERVER_HTTP_PORT={GRAFANA_PORT}"
+            f" GF_AUTH_ANONYMOUS_ENABLED=true"
+            ' GF_PATHS_HOME="$([ -d /usr/share/grafana ] &&'
+            " echo /usr/share/grafana)\""
+            " sh -c 'command -v grafana-server >/dev/null 2>&1 &&"
+            " exec grafana-server || exec grafana server'"
+        )
+        await ssh.execute(
+            host,
+            grafana_cmd,
+            CommandContext(
+                background=self.GRAFANA_SESSION,
+                log_file=f"{remote_dir}/grafana.log",
+            ),
+        )
+        # Liveness: a background spawn returns 0 whether or not the service
+        # survived its first moment — verify both session pidgroups are
+        # still alive (the remote analogue of start_grafana's local check).
+        for session in (self.PROM_SESSION, self.GRAFANA_SESSION):
+            pidfile = CommandContext(background=session).pidfile()
+            await ssh.execute(
+                host,
+                f"sleep 1; kill -0 -$(cat {pidfile})",
+            )
+        return f"http://{host.split('@')[-1]}:{GRAFANA_PORT}"
+
+    async def stop_remote(self, ssh, host: str) -> None:
+        await ssh.kill_session(host, self.PROM_SESSION)
+        await ssh.kill_session(host, self.GRAFANA_SESSION)
+
     def stop(self) -> None:
         for attr in ("prometheus_proc", "grafana_proc"):
             proc = getattr(self, attr)

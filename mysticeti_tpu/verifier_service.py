@@ -25,7 +25,7 @@ Wire protocol (little-endian, length-prefixed frames):
   HELLO    (1)   u16 n_keys | n_keys * 32 B pk      -> HELLO_OK once warm
   VERIFY   (2)   u32 req_id | u32 n | n * (u16 key_idx | 32 B digest | 64 B sig)
   RAW      (3)   u32 req_id | u32 n | n * (32 B pk | 32 B digest | 64 B sig)
-  HELLO_OK (128) empty
+  HELLO_OK (128) f64 fixed_dispatch_s | f64 per_sig_s   (empty = uncalibrated)
   RESULT   (129) u32 req_id | n * u8 ok
   ERR      (255) utf-8 message (protocol error; connection closes)
 
@@ -33,6 +33,14 @@ HELLO doubles as the warmup gate: the reply is sent only after the backend's
 one-time trace/compile finished, so a client's ``warmup()`` is "send HELLO,
 wait" — seconds against a warm service, never minutes.  All clients must
 present the same committee (one table per service); a mismatch is an ERR.
+
+HELLO_OK carries the service's OWN dispatch calibration (a timed 1-signature
+and batch dispatch after warmup): the hybrid router needs (fixed, per-sig)
+cost estimates, and N validators each probing a shared-host service would
+serialize N probe dispatches behind fleet boot contention — measured on a
+1-core host, 5 of 7 validators were still waiting for their probe a minute
+in.  One server-side measurement, taken once on an idle backend, is both
+cheaper and more accurate.
 """
 from __future__ import annotations
 
@@ -90,6 +98,7 @@ class VerifierServer:
         )
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
+        self._calibration: Optional[Tuple[float, float]] = None
 
     # -- backend lifecycle --
 
@@ -112,8 +121,40 @@ class VerifierServer:
                 self._backend = TpuSignatureVerifier(committee_keys=self._keys)
             if not self._warmed.is_set():
                 self._backend.warmup()
+                self._calibrate()
                 self._warmed.set()
             return self._backend
+
+    def _calibrate(self) -> None:
+        """Time the warmed backend once: a 1-signature dispatch (fixed cost)
+        and a 256-signature dispatch (marginal cost), on the deployed
+        committee-indexed path.  Shared with every client via HELLO_OK."""
+        import time
+
+        keys = self._keys or []
+        if not keys:
+            return
+        pk = keys[0]
+        digest = bytes(32)
+        sig = bytes(64)
+        try:
+            t0 = time.monotonic()
+            self._backend.verify_signatures([pk], [digest], [sig])
+            fixed = time.monotonic() - t0
+            n = 256
+            t0 = time.monotonic()
+            self._backend.verify_signatures(
+                [keys[i % len(keys)] for i in range(n)],
+                [digest] * n, [sig] * n,
+            )
+            batch_t = time.monotonic() - t0
+            self._calibration = (fixed, max(0.0, (batch_t - fixed) / n))
+            log.info(
+                "verifier service calibrated: %.1f ms fixed + %.1f µs/sig",
+                1e3 * self._calibration[0], 1e6 * self._calibration[1],
+            )
+        except Exception:  # calibration is advisory, never fatal
+            log.exception("verifier service calibration failed")
 
     def prewarm(self) -> None:
         """Warm before the first client connects (committee known at boot)."""
@@ -149,7 +190,10 @@ class VerifierServer:
                         writer.write(_frame(T_ERR, str(exc).encode()))
                         await writer.drain()
                         return
-                    writer.write(_frame(T_HELLO_OK, b""))
+                    calibration = b""
+                    if self._calibration is not None:
+                        calibration = struct.pack("<dd", *self._calibration)
+                    writer.write(_frame(T_HELLO_OK, calibration))
                     await writer.drain()
                 elif type_ in (T_VERIFY, T_RAW):
                     req_id, n = struct.unpack_from("<II", payload)
@@ -261,6 +305,9 @@ class RemoteSignatureVerifier(SignatureVerifier):
         self._index = {pk: i for i, pk in enumerate(self._keys)}
         self.timeout_s = timeout_s
         self._tls = threading.local()
+        # (fixed_dispatch_s, per_sig_s) as measured by the SERVICE on its
+        # own warmed backend (HELLO_OK payload); None until first connect.
+        self.calibration: Optional[Tuple[float, float]] = None
 
     # -- socket plumbing --
 
@@ -276,7 +323,14 @@ class RemoteSignatureVerifier(SignatureVerifier):
             raise ConnectionError(
                 f"verifier service rejected hello: {reply.decode(errors='replace')}"
             )
+        if len(reply) == 16:
+            self.calibration = struct.unpack("<dd", reply)
         return conn
+
+    def dispatch_calibration(self) -> Optional[Tuple[float, float]]:
+        """Server-measured (fixed_s, per_sig_s) — the hybrid router's cost
+        model, without every client paying its own probe dispatch."""
+        return self.calibration
 
     def _conn(self) -> socket.socket:
         conn = getattr(self._tls, "conn", None)

@@ -272,3 +272,45 @@ def test_settings_make_ssh_runner_and_testbed_logs(tmp_path):
     scps = [argv for argv in ssh.calls if argv[0] == "scp"]
     assert len(scps) == 2
     assert any("u@h0:/tmp/mysticeti-bench" in " ".join(a) for a in scps)
+
+
+def test_monitoring_stack_remote_deploy(tmp_path):
+    """monitor.rs:60-105 parity: the stack deploys onto a dedicated
+    monitoring instance over the ssh manager — config tree uploaded,
+    prometheus + grafana (re)started as background sessions."""
+    from mysticeti_tpu.orchestrator.monitor import (
+        GRAFANA_PORT,
+        MonitoringStack,
+    )
+
+    class RecordingSsh(SshManager):
+        def __init__(self):
+            super().__init__(["monitor@10.0.0.9"], retries=1)
+            self.commands = []
+
+        async def _spawn(self, argv, timeout_s):
+            self.commands.append(argv)
+            return 0, b""
+
+    ssh = RecordingSsh()
+    stack = MonitoringStack(str(tmp_path / "mon"))
+    url = run(
+        stack.deploy_remote(
+            ssh, "monitor@10.0.0.9", ["10.0.0.1:1500", "10.0.0.2:1500"]
+        )
+    )
+    assert url == f"http://10.0.0.9:{GRAFANA_PORT}"
+    # The generated tree exists locally and was scp'd to the instance.
+    assert (tmp_path / "mon" / "prometheus.yaml").exists()
+    flat = ["\x00".join(argv) for argv in ssh.commands]
+    assert any(a.startswith("scp") and "prometheus.yaml" in a for a in flat)
+    joined = " ".join(flat)
+    assert "prometheus --config.file=" in joined
+    assert "grafana server" in joined
+    # Both services run as background sessions (kill_session-compatible).
+    assert "mysticeti-prometheus" in joined
+    assert "mysticeti-grafana" in joined
+    # Teardown kills both sessions.
+    before = len(ssh.commands)
+    run(stack.stop_remote(ssh, "monitor@10.0.0.9"))
+    assert len(ssh.commands) == before + 2

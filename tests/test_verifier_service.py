@@ -73,6 +73,10 @@ def test_roundtrip_indexed_and_raw(tmp_path, signers):
         client = RemoteSignatureVerifier(
             socket_path=server.socket_path, committee_keys=keys
         )
+        await asyncio.to_thread(client.warmup)
+        base = backend.calls  # warmup + server-side calibration dispatches
+        # The service measured its own dispatch costs and shared them.
+        assert client.dispatch_calibration() is not None
         pks, digests, sigs = _sigs(8, signers)
         # Corrupt one signature: result order must be preserved.
         sigs[3] = bytes(64)
@@ -90,7 +94,7 @@ def test_roundtrip_indexed_and_raw(tmp_path, signers):
             [stranger.sign(digest)],
         )
         assert ok == [True]
-        assert backend.calls == 2
+        assert backend.calls == base + 2
 
     asyncio.run(_with_server(tmp_path, keys, backend, scenario))
 
@@ -185,7 +189,8 @@ def test_concurrent_clients_share_one_backend(tmp_path, signers):
 
         results = await asyncio.gather(*(one_validator(i) for i in range(4)))
         assert all(all(r) for r in results)
-        assert backend.calls == 4
+        # 4 verify dispatches on top of warmup + server-side calibration.
+        assert backend.calls == 2 + 4
 
     asyncio.run(_with_server(tmp_path, keys, backend, scenario))
 

@@ -98,8 +98,11 @@ async def run_one(verifier: str, nodes: int, load: int, down_s: float,
                   workdir: str) -> dict:
     from mysticeti_tpu.orchestrator.runner import LocalProcessRunner
 
-    is_tpu = verifier.startswith("tpu")
-    os.environ["INITIAL_DELAY"] = "10" if is_tpu else "1"
+    # The shared verifier service made tpu warmup a non-event (the runner
+    # blocks until the service is warm before booting nodes), so the load
+    # delay no longer needs a tpu asymmetry.  Pinned (not setdefault) so an
+    # ambient INITIAL_DELAY cannot skew one flavor's steady window.
+    os.environ["INITIAL_DELAY"] = "1"
     runner = LocalProcessRunner(
         os.path.join(workdir, f"fleet-{verifier}"), verifier=verifier
     )
@@ -113,7 +116,15 @@ async def run_one(verifier: str, nodes: int, load: int, down_s: float,
     # warmup here, against the persistent compile cache).
     async def committing():
         m = await scrape_parsed(runner, 0)
-        if m and metric(m, "commit_round") > 30:
+        # Steady = consensus cadence AND transaction flow: opening the
+        # window on commit_round alone can catch the pre-generator phase
+        # (boot contention delays tx flow ~tens of seconds on a 1-core
+        # host), recording steady_tps=0 for a fleet that is fine.
+        if (
+            m
+            and metric(m, "commit_round") > 30
+            and metric(m, 'latency_s_count{workload="shared"}') > 0
+        ):
             return m
         return None
 
