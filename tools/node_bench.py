@@ -50,17 +50,11 @@ async def run_one(verifier: str, nodes: int, load: int, duration: float,
 
     fleet = os.path.join(workdir, f"fleet-{verifier}")
     results = os.path.join(workdir, f"results-{verifier}")
-    if verifier.startswith("tpu"):
-        # Generators gate on verifier warmup (TransactionGenerator.ready), so
-        # the delay only needs to cover post-warmup pipeline settling; the
-        # scrape window must outlast warmup (minutes when several processes
-        # share one host core) plus a steady-state measurement stretch.  tps
-        # itself is warmup-insensitive: benchmark_duration opens at the first
-        # committed tx.
-        os.environ["INITIAL_DELAY"] = "10"
-        duration = max(duration, 240.0)
-    else:
-        os.environ.pop("INITIAL_DELAY", None)
+    # The shared verifier service removed the tpu warmup asymmetry: the
+    # runner blocks until the service is warm before booting nodes, and
+    # nodes seed their routers from the service's HELLO_OK calibration
+    # instead of probing.  Identical delays keep the rows comparable.
+    os.environ["INITIAL_DELAY"] = "1"
     runner = LocalProcessRunner(fleet, verifier=verifier)
     generator = ParametersGenerator(
         nodes, LoadType.fixed([load]), duration_s=duration
