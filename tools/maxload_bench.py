@@ -27,17 +27,19 @@ async def search_one(verifier: str, nodes: int, start_load: int,
     from mysticeti_tpu.orchestrator.orchestrator import Orchestrator
     from mysticeti_tpu.orchestrator.runner import LocalProcessRunner
 
-    if verifier.startswith("tpu"):
+    # The shared verifier service removed the per-node warmup that used to
+    # force 240 s tpu probe windows (the runner blocks until the service is
+    # warm BEFORE booting nodes; validators are jax-free and seed their
+    # routers from HELLO_OK).  Identical delays keep probes comparable.
+    os.environ["INITIAL_DELAY"] = "1"
+    if verifier.startswith("tpu") and os.environ.get(
+        "MYSTICETI_NO_VERIFIER_SERVICE"
+    ):
+        # Service opted out: every node builds a cold JAX runtime again —
+        # the probe window must outlast the old ~2-3 min contended warmup
+        # or each probe measures zero tx and the search bisects down.
         os.environ["INITIAL_DELAY"] = "10"
-        # Node warmup (4 procs sharing one core: jax init + persistent-cache
-        # executable loads) runs ~2-3 min before load generators start; the
-        # probe window must outlast it plus a steady-state stretch.  tps
-        # itself is warmup-insensitive (benchmark_duration opens at the
-        # first committed tx), but a window shorter than warmup measures
-        # zero committed tx and the search wrongly bisects down.
         duration = max(duration, 240.0)
-    else:
-        os.environ.pop("INITIAL_DELAY", None)
     runner = LocalProcessRunner(
         os.path.join(workdir, f"fleet-{verifier}"), verifier=verifier
     )
