@@ -82,7 +82,7 @@ class TestCommitObserver(CommitObserver):
     def handle_commit(self, committed_leaders):
         now = time.time()
         committed = self.commit_interpreter.handle_commit(committed_leaders)
-        txs: List[bytes] = []
+        stamps: List[bytes] = []
         for commit in committed:
             self.committed_leaders.append(commit.anchor)
             for block in commit.blocks:
@@ -100,7 +100,7 @@ class TestCommitObserver(CommitObserver):
                             if created is not None:
                                 channel.observe(max(0.0, now - created))
                 if self.metrics is not None:
-                    txs.extend(t for _, t in block.shared_transactions())
+                    stamps.append(block.shared_transaction_stamps())
         if committed and self.metrics is not None:
             # meta_creation_time_ns is stamped with runtime.timestamp_utc()
             # (virtual time under the simulator) — the comparison clock must
@@ -121,16 +121,19 @@ class TestCommitObserver(CommitObserver):
                         self.metrics.block_commit_latency.observe(
                             max(0.0, now_utc - created / 1e9)
                         )
-        if txs:
-            self._update_metrics_batch(txs, now)
+        heads = b"".join(stamps)
+        if heads:
+            self._update_metrics_batch(heads, now)
         return committed
 
-    def _update_metrics_batch(self, transactions: List[bytes], now: float) -> None:
+    def _update_metrics_batch(self, heads: bytes, now: float) -> None:
         """Benchmark metrics (commit_observer.rs:104-140): latency measured
-        from the 8-byte float64 submission timestamp the generator prefixes to
-        each tx.  One vectorized update per commit batch — the per-transaction
-        version dominated the engine profile at load (observed: a third of
-        handle_commit's time went to prometheus label lookups + observes)."""
+        from the 8-byte float64 submission timestamp the generator prefixes
+        to each tx.  ``heads`` is the pre-concatenated stamp bytes
+        (``shared_transaction_stamps``); everything from here is one
+        vectorized pass — per-transaction Python objects dominated the
+        engine profile at load, twice (r4: prometheus observes; r5: locator
+        construction + double iteration)."""
         import numpy as np
 
         if self._bench_t0 is None:
@@ -139,9 +142,6 @@ class TestCommitObserver(CommitObserver):
         delta = int(elapsed) - int(self.metrics.benchmark_duration._value.get())
         if delta > 0:
             self.metrics.benchmark_duration.inc(delta)
-        heads = b"".join(
-            t[:8] if len(t) >= 8 else b"\x00" * 8 for t in transactions
-        )
         ts = np.frombuffer(heads, "<f8")
         latencies = np.maximum(0.0, now - ts)
         latencies[ts == 0.0] = 0.0  # unstamped txs count as zero latency

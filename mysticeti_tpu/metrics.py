@@ -51,7 +51,8 @@ class PreciseHistogram:
     worst possible sample).  ``count``/``sum`` stay cumulative for ``avg``.
     """
 
-    __slots__ = ("samples", "count", "sum", "max_samples", "_window_count", "_rng")
+    __slots__ = ("samples", "count", "sum", "max_samples", "_window_count",
+                 "_rng", "_np_rng")
 
     def __init__(self, max_samples: int = 100_000) -> None:
         import random
@@ -62,6 +63,7 @@ class PreciseHistogram:
         self.max_samples = max_samples
         self._window_count = 0
         self._rng = random.Random(0xC0FFEE)
+        self._np_rng = None  # built lazily on the first batched observe
 
     def observe(self, value: float) -> None:
         self.count += 1
@@ -96,12 +98,16 @@ class PreciseHistogram:
             return
         # Algorithm R, batched: the k-th remaining value is the
         # (window_count + k)-th of the window; it replaces a random slot
-        # with probability cap / (window_count + k).
+        # with probability cap / (window_count + k).  Slot draws are one
+        # vectorized uniform per batch — a Python randrange per sample
+        # measured 7% of a saturated node's core (round-5 profile).
         import numpy as np
 
+        if self._np_rng is None:
+            self._np_rng = np.random.default_rng(0xC0FFEE)
         idx = np.arange(self._window_count + 1, self._window_count + n + 1)
         self._window_count += n
-        slots = (np.array([self._rng.randrange(i) for i in idx]))
+        slots = (self._np_rng.random(n) * idx).astype(np.int64)
         hit = slots < cap
         for slot, value in zip(slots[hit], np.asarray(values)[hit]):
             self.samples[slot] = float(value)

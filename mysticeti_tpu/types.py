@@ -587,6 +587,23 @@ class StatementBlock:
             if isinstance(st, Share):
                 yield TransactionLocator(self.reference, offset), st.transaction
 
+    def shared_transaction_stamps(self) -> bytes:
+        """Concatenated first-8-byte prefixes of every Share payload — the
+        benchmark submission stamps the commit observer's latency metrics
+        read.  A dedicated path because ``shared_transactions`` constructs a
+        locator per transaction: at saturation that was ~1M frozen-dataclass
+        builds per reporting window, discarded immediately (round-5 profile).
+        """
+        out = []
+        for st in self.statements:
+            if isinstance(st, Share):
+                t = st.transaction
+                # Sub-8-byte payloads carry no stamp: emit ZERO so the
+                # ts==0 "unstamped" guard downstream zeroes their latency
+                # (padding real bytes would decode as a denormal float).
+                out.append(t[:8] if len(t) >= 8 else b"\x00" * 8)
+        return b"".join(out)
+
     # -- verification (types.rs:315-376) --
 
     def verify_structure(self, committee) -> None:

@@ -236,12 +236,12 @@ def test_benchmark_duration_starts_at_first_commit(tmp_path, monkeypatch):
     # 300 s of warmup pass with no commits: duration must stay 0.
     t[0] += 300.0
     tx = struct.pack("<d", 0.0) + b"\0" * 24
-    observer._update_metrics_batch([tx], now=0.0)
+    observer._update_metrics_batch(tx[:8], now=0.0)
     assert metrics.benchmark_duration._value.get() == 0.0
 
     # 20 s into the loaded phase the counter reflects loaded time only.
     t[0] += 20.0
-    observer._update_metrics_batch([tx], now=0.0)
+    observer._update_metrics_batch(tx[:8], now=0.0)
     assert metrics.benchmark_duration._value.get() == 20.0
 
 
