@@ -687,16 +687,22 @@ def shared_ranges(block: StatementBlock) -> List[TransactionLocatorRange]:
     """Contiguous runs of Share statements in a block as locator ranges
     (types.rs shared_ranges equivalent used by committee.rs:455); run-length
     compression delegated to VoteRangeBuilder so there is one copy of that logic."""
-    builder = VoteRangeBuilder()
-    runs: List[Tuple[int, int]] = []
-    for i, st in enumerate(block.statements):
-        if isinstance(st, Share):
-            done = builder.add(i)
-            if done is not None:
-                runs.append(done)
-    tail = builder.finish()
-    if tail is not None:
-        runs.append(tail)
+    runs = getattr(block, "_share_runs", None)
+    if runs is None:
+        # Locally built block: walk the statements.  Wire-decoded blocks
+        # carry spans precomputed by the native decoder — re-walking 10k+
+        # statements per block here was a top interpreter cost at load.
+        builder = VoteRangeBuilder()
+        collected: List[Tuple[int, int]] = []
+        for i, st in enumerate(block.statements):
+            if isinstance(st, Share):
+                done = builder.add(i)
+                if done is not None:
+                    collected.append(done)
+        tail = builder.finish()
+        if tail is not None:
+            collected.append(tail)
+        runs = collected
     return [TransactionLocatorRange(block.reference, s, e) for s, e in runs]
 
 

@@ -838,7 +838,9 @@ PyObject* make_locator(PyObject* ref, uint64_t offset) {
 
 // decode_block(data)
 //   -> (authority, round, includes, statements, meta_ns, epoch_marker,
-//       epoch, signature)
+//       epoch, signature, share_runs)
+// share_runs: tuple of (start, end) half-open spans of contiguous Share
+// statements (committee.shared_ranges precompute).
 // Raises ValueError on any malformed input (same cases as the Python
 // decoder; types.py maps it to SerdeError).
 PyObject* decode_block(PyObject*, PyObject* args) {
@@ -893,12 +895,20 @@ PyObject* decode_block(PyObject*, PyObject* args) {
     return fail("statement tag");
   statements = PyList_New(cnt);
   if (statements == nullptr) return fail("statements alloc");
+  // Share run-length spans (committee.shared_ranges precompute): collected
+  // for free while walking statements.
+  std::vector<std::pair<uint32_t, uint32_t>> share_runs;
   for (uint32_t i = 0; i < cnt; i++) {
     if (pos + 1 > n) return fail("statement tag");
     const uint8_t tag = d[pos];
     pos += 1;
     PyObject* st = nullptr;
     if (tag == kStShare) {
+      if (!share_runs.empty() && share_runs.back().second == i) {
+        share_runs.back().second = i + 1;
+      } else {
+        share_runs.emplace_back(i, i + 1);
+      }
       if (pos + 4 > n) return fail("share length");
       const uint32_t ln = read_u32(d + pos);
       pos += 4;
@@ -1033,11 +1043,26 @@ PyObject* decode_block(PyObject*, PyObject* args) {
     PyErr_Format(PyExc_ValueError, "trailing garbage: %zd bytes", n - pos);
     return fail("trailer garbage");
   }
+  PyObject* runs = PyTuple_New(static_cast<Py_ssize_t>(share_runs.size()));
+  if (runs == nullptr) {
+    Py_DECREF(signature);
+    return fail("runs alloc");
+  }
+  for (size_t i = 0; i < share_runs.size(); i++) {
+    PyObject* pair = Py_BuildValue("(II)", share_runs[i].first,
+                                   share_runs[i].second);
+    if (pair == nullptr) {
+      Py_DECREF(runs);
+      Py_DECREF(signature);
+      return fail("runs pair");
+    }
+    PyTuple_SET_ITEM(runs, static_cast<Py_ssize_t>(i), pair);
+  }
   result = Py_BuildValue(
-      "(KKNNKBKN)", static_cast<unsigned long long>(authority),
+      "(KKNNKBKNN)", static_cast<unsigned long long>(authority),
       static_cast<unsigned long long>(round), includes, statements,
       static_cast<unsigned long long>(meta_ns), epoch_marker,
-      static_cast<unsigned long long>(epoch), signature);
+      static_cast<unsigned long long>(epoch), signature, runs);
   if (result == nullptr) {
     // includes/statements ownership consumed on success only.
     PyBuffer_Release(&buf);

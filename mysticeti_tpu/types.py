@@ -292,6 +292,11 @@ class StatementBlock:
         "signature",
         "_bytes",
         "_digest_trusted",
+        # Share run-length spans precomputed by the native decoder (None on
+        # locally built blocks): committee.shared_ranges was a 26M-iteration
+        # interpreter loop per measurement window at saturation, re-walking
+        # statements the C decoder had already visited.
+        "_share_runs",
     )
 
     def __init__(
@@ -314,6 +319,7 @@ class StatementBlock:
         self.epoch = epoch
         self.signature = signature
         self._bytes = _bytes
+        self._share_runs = None
         # True only on construction paths that DERIVED the reference digest
         # from the exact cached bytes (from_bytes): re-hashing the same
         # bytes in verify_structure would compare a hash with itself — at
@@ -449,16 +455,21 @@ class StatementBlock:
             # share statements cost the interpreter loop ~77 ms; the C
             # walk builds the same frozen-dataclass objects in a fraction.
             try:
-                (authority, round_, includes, statements, meta_ns,
-                 epoch_marker, epoch, signature) = _native_decode(data)
+                decoded = _native_decode(data)
             except ValueError as exc:
                 raise SerdeError(str(exc)) from None
+            # Unpack OUTSIDE the except: an arity mismatch here means a
+            # stale compiled extension (build skew) and must fail loudly,
+            # not masquerade as malformed wire data.
+            (authority, round_, includes, statements, meta_ns,
+             epoch_marker, epoch, signature, share_runs) = decoded
             digest = crypto.blake2b_256(data)
             block = cls(
                 BlockReference(authority, round_, digest), tuple(includes),
                 tuple(statements), meta_ns, epoch_marker, epoch, signature,
                 _bytes=bytes(data), _digest_trusted=True,
             )
+            block._share_runs = share_runs
             if memo is not None:
                 if len(memo) >= cls._DECODE_MEMO_CAP:
                     memo.clear()

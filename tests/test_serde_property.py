@@ -257,3 +257,36 @@ def test_forged_huge_counts_rejected_without_allocation():
                     StatementBlock.from_bytes(frame)
             finally:
                 types_mod._native_decode = saved
+
+
+@given(data=st.data())
+@settings(max_examples=60, deadline=None)
+def test_decoder_share_runs_match_python_walk(data):
+    """The native decoder's precomputed share spans must equal the
+    statement-walk result (committee.shared_ranges fast path)."""
+    import mysticeti_tpu.types as types_mod
+    from mysticeti_tpu.committee import shared_ranges
+    from mysticeti_tpu.types import (
+        BlockReference,
+        TransactionLocator,
+        Vote,
+    )
+
+    if types_mod._native_decode is None:
+        pytest.skip("native extension unavailable")
+
+    statements = []
+    for _ in range(data.draw(st.integers(0, 12))):
+        if data.draw(st.booleans()):
+            statements.append(Share(data.draw(st.binary(max_size=40))))
+        else:
+            ref = BlockReference(0, 1, bytes(32))
+            statements.append(
+                Vote(TransactionLocator(ref, data.draw(st.integers(0, 9))))
+            )
+    built = StatementBlock.build(0, 7, GENESIS, statements, signer=SIGNERS[0])
+    decoded = StatementBlock.from_bytes(built.to_bytes())
+    # The fast path must actually be in play (walk-vs-walk would be vacuous).
+    assert decoded._share_runs is not None
+    assert built._share_runs is None
+    assert shared_ranges(decoded) == shared_ranges(built)
