@@ -838,9 +838,11 @@ PyObject* make_locator(PyObject* ref, uint64_t offset) {
 
 // decode_block(data)
 //   -> (authority, round, includes, statements, meta_ns, epoch_marker,
-//       epoch, signature, share_runs)
+//       epoch, signature, share_runs, stamps)
 // share_runs: tuple of (start, end) half-open spans of contiguous Share
 // statements (committee.shared_ranges precompute).
+// stamps: bytes, 8 per Share statement — the payload's first 8 bytes, or
+// zeros for sub-8-byte payloads (commit-observer latency input).
 // Raises ValueError on any malformed input (same cases as the Python
 // decoder; types.py maps it to SerdeError).
 PyObject* decode_block(PyObject*, PyObject* args) {
@@ -898,6 +900,10 @@ PyObject* decode_block(PyObject*, PyObject* args) {
   // Share run-length spans (committee.shared_ranges precompute): collected
   // for free while walking statements.
   std::vector<std::pair<uint32_t, uint32_t>> share_runs;
+  // Benchmark submission stamps: first 8 bytes of every Share payload
+  // (zero for sub-8-byte payloads) — the commit observer's latency input,
+  // collected for free during the parse.
+  std::string stamps;
   for (uint32_t i = 0; i < cnt; i++) {
     if (pos + 1 > n) return fail("statement tag");
     const uint8_t tag = d[pos];
@@ -913,6 +919,11 @@ PyObject* decode_block(PyObject*, PyObject* args) {
       const uint32_t ln = read_u32(d + pos);
       pos += 4;
       if (pos + static_cast<Py_ssize_t>(ln) > n) return fail("share payload");
+      if (ln >= 8) {
+        stamps.append(reinterpret_cast<const char*>(d + pos), 8);
+      } else {
+        stamps.append(8, '\0');
+      }
       PyObject* payload = PyBytes_FromStringAndSize(
           reinterpret_cast<const char*>(d + pos), ln);
       if (payload == nullptr) return fail("share alloc");
@@ -1058,11 +1069,18 @@ PyObject* decode_block(PyObject*, PyObject* args) {
     }
     PyTuple_SET_ITEM(runs, static_cast<Py_ssize_t>(i), pair);
   }
+  PyObject* stamp_bytes = PyBytes_FromStringAndSize(
+      stamps.data(), static_cast<Py_ssize_t>(stamps.size()));
+  if (stamp_bytes == nullptr) {
+    Py_DECREF(runs);
+    Py_DECREF(signature);
+    return fail("stamps alloc");
+  }
   result = Py_BuildValue(
-      "(KKNNKBKNN)", static_cast<unsigned long long>(authority),
+      "(KKNNKBKNNN)", static_cast<unsigned long long>(authority),
       static_cast<unsigned long long>(round), includes, statements,
       static_cast<unsigned long long>(meta_ns), epoch_marker,
-      static_cast<unsigned long long>(epoch), signature, runs);
+      static_cast<unsigned long long>(epoch), signature, runs, stamp_bytes);
   if (result == nullptr) {
     // includes/statements ownership consumed on success only.
     PyBuffer_Release(&buf);

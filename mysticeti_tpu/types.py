@@ -297,6 +297,9 @@ class StatementBlock:
         # interpreter loop per measurement window at saturation, re-walking
         # statements the C decoder had already visited.
         "_share_runs",
+        # Concatenated 8-byte submission stamps, also decoder-precomputed
+        # (the commit observer's latency input).
+        "_stamps",
     )
 
     def __init__(
@@ -320,6 +323,7 @@ class StatementBlock:
         self.signature = signature
         self._bytes = _bytes
         self._share_runs = None
+        self._stamps = None
         # True only on construction paths that DERIVED the reference digest
         # from the exact cached bytes (from_bytes): re-hashing the same
         # bytes in verify_structure would compare a hash with itself — at
@@ -462,7 +466,7 @@ class StatementBlock:
             # stale compiled extension (build skew) and must fail loudly,
             # not masquerade as malformed wire data.
             (authority, round_, includes, statements, meta_ns,
-             epoch_marker, epoch, signature, share_runs) = decoded
+             epoch_marker, epoch, signature, share_runs, stamps) = decoded
             digest = crypto.blake2b_256(data)
             block = cls(
                 BlockReference(authority, round_, digest), tuple(includes),
@@ -470,6 +474,7 @@ class StatementBlock:
                 _bytes=bytes(data), _digest_trusted=True,
             )
             block._share_runs = share_runs
+            block._stamps = stamps
             if memo is not None:
                 if len(memo) >= cls._DECODE_MEMO_CAP:
                     memo.clear()
@@ -605,6 +610,8 @@ class StatementBlock:
         locator per transaction: at saturation that was ~1M frozen-dataclass
         builds per reporting window, discarded immediately (round-5 profile).
         """
+        if self._stamps is not None:  # decoder-precomputed (wire blocks)
+            return self._stamps
         out = []
         for st in self.statements:
             if isinstance(st, Share):

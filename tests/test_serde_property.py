@@ -290,3 +290,25 @@ def test_decoder_share_runs_match_python_walk(data):
     assert decoded._share_runs is not None
     assert built._share_runs is None
     assert shared_ranges(decoded) == shared_ranges(built)
+
+
+@given(payloads=st.lists(st.binary(min_size=0, max_size=24), max_size=10))
+@settings(max_examples=60, deadline=None)
+def test_decoder_stamps_match_python_walk(payloads):
+    """Decoder-precomputed stamp bytes == the statement-walk result,
+    including the zero-stamp rule for sub-8-byte payloads."""
+    import mysticeti_tpu.types as types_mod
+
+    if types_mod._native_decode is None:
+        pytest.skip("native extension unavailable")
+    built = StatementBlock.build(
+        0, 3, GENESIS, [Share(p) for p in payloads], signer=SIGNERS[0]
+    )
+    decoded = StatementBlock.from_bytes(built.to_bytes())
+    assert decoded._stamps is not None and built._stamps is None
+    assert decoded.shared_transaction_stamps() == \
+        built.shared_transaction_stamps()
+    expected = b"".join(
+        p[:8] if len(p) >= 8 else b"\x00" * 8 for p in payloads
+    )
+    assert decoded.shared_transaction_stamps() == expected
