@@ -25,7 +25,13 @@ from collections import deque
 
 from .block_store import CommitData, OwnBlockData
 from .serde import Reader, Writer
-from .types import BaseStatement, BlockReference, StatementBlock, decode_statement, encode_statement
+from .types import (
+    BaseStatement,
+    BlockReference,
+    StatementBlock,
+    decode_statement,
+    encode_statements,
+)
 from .wal import WalPosition
 
 
@@ -49,8 +55,7 @@ MetaStatement = Union[Include, Payload]
 def encode_payload(statements) -> bytes:
     w = Writer()
     w.u32(len(statements))
-    for st in statements:
-        encode_statement(w, st)
+    encode_statements(w, statements)
     return w.finish()
 
 

@@ -170,6 +170,26 @@ class VoteRange:
 BaseStatement = object  # Share | Vote | VoteRange
 
 
+def encode_statements(w: Writer, statements: Sequence[BaseStatement]) -> None:
+    """Encode a statement sequence with the Share hot path inlined: a
+    saturated proposer encodes ~10k Shares per block (and each statement is
+    encoded twice — pending-payload WAL entry, then the proposal), so the
+    per-call Writer dispatch was a measurable interpreter cost.  Bytes are
+    identical to per-statement ``encode_statement`` (round-trip property
+    tests pin canonicality)."""
+    buf = w.buf
+    pack_len = _U32_AT.pack
+    share_tag = bytes([_ST_SHARE])
+    for st in statements:
+        if type(st) is Share:
+            t = st.transaction
+            buf += share_tag
+            buf += pack_len(len(t))
+            buf += t
+        else:
+            encode_statement(w, st)
+
+
 def encode_statement(w: Writer, st: BaseStatement) -> None:
     if isinstance(st, Share):
         w.u8(_ST_SHARE).bytes(st.transaction)
@@ -350,8 +370,7 @@ class StatementBlock:
         for inc in includes:
             inc.encode(w)
         w.u32(len(statements))
-        for st in statements:
-            encode_statement(w, st)
+        encode_statements(w, statements)
         w.u64(meta_creation_time_ns)
         w.u8(epoch_marker)
         w.u64(epoch)
