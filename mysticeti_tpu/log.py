@@ -46,11 +46,18 @@ class TransactionLog:
         """Bulk form: one line per offset, identical format to ``log`` —
         certification arrives in contiguous runs and the per-line method
         call + f-string was measurable at fleet saturation."""
+        if start >= end:
+            return
         prefix = f"{block.authority},{block.round},{block.digest.hex()},"
         self._last_block = block
         self._last_prefix = prefix
+        # map(str, range) keeps the per-offset work in C: a per-line
+        # f-string re-rendered the constant prefix 1.4M times per
+        # measurement window at saturation.
         self._file.write(
-            "".join(f"{prefix}{off}\n" for off in range(start, end))
+            prefix
+            + ("\n" + prefix).join(map(str, range(start, end)))
+            + "\n"
         )
 
     def flush(self) -> None:
