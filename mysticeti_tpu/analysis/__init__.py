@@ -1,0 +1,56 @@
+"""mysticeti-lint: AST-based invariant checker for this codebase's failure modes.
+
+The hardest correctness rules in this repository were, until this package,
+encoded only in comments — "the backend label must be captured in the same
+thread as the dispatch" (block_validator.py), "EMA read-modify-writes happen
+from executor threads; serialize them", "the device dispatch runs in a worker
+thread so the event loop never blocks".  This package mechanizes them as six
+stdlib-``ast`` rules, runnable as ``python -m mysticeti_tpu.analysis``:
+
+* ``async-blocking``   — blocking call (``time.sleep``, sync subprocess/socket
+  I/O, a direct ``verify_signatures`` dispatch) inside an ``async def`` body
+  without ``run_in_executor``.
+* ``task-orphan``      — ``asyncio.ensure_future``/``create_task`` whose handle
+  is never awaited and never given an exception-logging done-callback (the
+  swallowed-exception pattern); ``utils.tasks.spawn_logged`` is the compliant
+  spawner.
+* ``lock-discipline``  — ``await`` inside a ``threading.Lock`` ``with`` block
+  (deadlocks the event loop), and designated shared EMA/counter fields mutated
+  outside their designated lock.
+* ``jit-purity``       — host-side impurities (``.item()``, ``np.*`` calls,
+  ``print``, ``jax.debug.print``, wall-clock reads) inside ``@jax.jit``-
+  compiled or pallas kernel functions under ``ops/`` and ``parallel/``.
+* ``wall-clock``       — ``time.time()`` used to measure an interval where
+  ``time.monotonic()`` is required (wall clock steps under NTP).
+* ``metrics-labels``   — every ``.labels(...)`` call site must match the
+  arity/names declared for that series in ``metrics.py``.
+
+Exit status: 0 = no new findings, 1 = new findings (or bad usage: 2).
+Deliberate exceptions carry an inline ``# lint: ignore[rule]`` suppression;
+legacy debt lives in ``analysis/baseline.json`` (regenerate with
+``python -m mysticeti_tpu.analysis --baseline-regen`` or
+``tools/lint.py --baseline-regen``).  See ``docs/static-analysis.md``.
+"""
+from .checker import (
+    Finding,
+    RULES,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from .cli import main
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "load_baseline",
+    "main",
+    "new_findings",
+    "write_baseline",
+]

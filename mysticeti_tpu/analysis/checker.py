@@ -1,0 +1,796 @@
+"""The six AST rules and the finding/baseline machinery.
+
+Pure stdlib (``ast``, ``json``, ``re``); no imports of the package under
+analysis, so the checker runs even when optional heavy deps (jax, numpy,
+prometheus_client) are absent or broken.
+
+Every rule is deliberately *syntactic* and scoped to this codebase's idioms:
+precision over generality.  A rule that cries wolf gets suppressed wholesale
+and enforces nothing; each detector below accepts known-good shapes (handles
+awaited in-scope, dispatch hidden behind ``run_in_executor``, casts of static
+shapes) so that what remains flagged is worth a human look.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULE_ASYNC_BLOCKING = "async-blocking"
+RULE_TASK_ORPHAN = "task-orphan"
+RULE_LOCK_DISCIPLINE = "lock-discipline"
+RULE_JIT_PURITY = "jit-purity"
+RULE_WALL_CLOCK = "wall-clock"
+RULE_METRICS_LABELS = "metrics-labels"
+
+RULES = (
+    RULE_ASYNC_BLOCKING,
+    RULE_TASK_ORPHAN,
+    RULE_LOCK_DISCIPLINE,
+    RULE_JIT_PURITY,
+    RULE_WALL_CLOCK,
+    RULE_METRICS_LABELS,
+)
+
+# -- rule configuration -------------------------------------------------------
+
+# Rule 1: calls that block the event loop when made directly from a coroutine.
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+    "requests.request",
+}
+# Method names that are synchronous accelerator dispatches: a direct call in a
+# coroutine stalls consensus for the whole device round-trip (the
+# BatchedSignatureVerifier comment: "the device dispatch runs in a worker
+# thread so the event loop never blocks").
+BLOCKING_METHODS = {"verify_signatures"}
+
+# Rule 2: task spawners whose naked handle swallows exceptions.
+SPAWN_NAMES = {"ensure_future", "create_task"}
+# Uses of a task handle that constitute supervision: someone will observe the
+# task's exception.
+_WAITER_SUFFIXES = ("wait", "wait_for", "gather", "shield")
+
+# Rule 3b: shared fields with a designated lock (the comment-documented
+# EMA/counter discipline in block_validator.py).  Mutations anywhere but
+# ``__init__`` must sit lexically inside ``with self.<lock>:``.
+GUARDED_FIELDS: Dict[str, str] = {
+    "_dispatch_ema_s": "_lock",
+    "cpu_per_sig_s": "_ema_lock",
+    "tpu_dispatch_s": "_ema_lock",
+    "tpu_per_sig_s": "_ema_lock",
+}
+
+# Rule 4: directories whose jitted functions must stay trace-pure.
+JIT_PURITY_DIRS = ("ops", "parallel")
+JIT_IMPURE_CALLS = {
+    "jax.debug.print",
+    "jax.debug.breakpoint",
+}
+JIT_IMPURE_PREFIXES = ("numpy.", "time.")
+
+_IGNORE_RE = re.compile(r"#\s*lint:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self) -> str:
+        """Line-independent identity used by the baseline: survives pure
+        line-number drift, invalidates when the code itself changes."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+def _dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c" with the leading segment resolved through import
+    aliases (``import numpy as np`` makes ``np.x`` -> "numpy.x")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _is_lock_ctor(call: ast.AST, aliases: Dict[str, str]) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    dotted = _dotted(call.func, aliases)
+    return dotted in {"threading.Lock", "threading.RLock"}
+
+
+def _collect_class_locks(
+    cls: ast.ClassDef, aliases: Dict[str, str]
+) -> Set[str]:
+    """Attribute names assigned a ``threading.Lock()`` anywhere in the class."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value, aliases):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    locks.add(target.attr)
+    return locks
+
+
+def _collect_jit_targets(tree: ast.Module, aliases: Dict[str, str]) -> Set[str]:
+    """Function names compiled indirectly: ``k = jax.jit(fn)`` and pallas
+    kernels (``pl.pallas_call(fn, ...)``)."""
+    targets: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, aliases) or ""
+        if dotted in {"jax.jit", "jit"} and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                targets.add(arg.id)
+        if dotted.endswith("pallas_call") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                targets.add(arg.id)
+    return targets
+
+
+def _is_jit_decorated(fn: ast.AST, aliases: Dict[str, str]) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for deco in fn.decorator_list:
+        dotted = _dotted(deco, aliases)
+        if dotted in {"jax.jit", "jit"}:
+            return True
+        if isinstance(deco, ast.Call):
+            dotted = _dotted(deco.func, aliases)
+            if dotted in {"jax.jit", "jit"}:
+                return True
+            if dotted in {"functools.partial", "partial"} and deco.args:
+                inner = _dotted(deco.args[0], aliases)
+                if inner in {"jax.jit", "jit"}:
+                    return True
+    return False
+
+
+def collect_metric_labels(tree: ast.Module) -> Dict[str, Tuple[str, ...]]:
+    """Declared label tuples per series attribute, from metrics.py's
+    ``self.X = counter/gauge/histogram(name, doc, labels=(...))`` idiom (and
+    raw prometheus_client constructors with ``labelnames=``)."""
+    declared: Dict[str, Tuple[str, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        func = call.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name not in {
+            "counter", "gauge", "histogram", "Counter", "Gauge", "Histogram",
+        }:
+            continue
+        labels: Tuple[str, ...] = ()
+        for kw in call.keywords:
+            if kw.arg in {"labels", "labelnames"}:
+                if isinstance(kw.value, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in kw.value.elts
+                ):
+                    labels = tuple(e.value for e in kw.value.elts)
+                else:
+                    labels = ("<dynamic>",)
+        if labels == ("<dynamic>",):
+            continue  # computed label list: not statically checkable, skip
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                declared[target.attr] = labels
+            elif isinstance(target, ast.Name):
+                declared[target.id] = labels
+    return declared
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule set (None = all rules)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _IGNORE_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {part.strip() for part in m.group(1).split(",") if part.strip()}
+    return out
+
+
+class _FunctionScope:
+    """Per-function bookkeeping for the task-orphan and wall-clock rules."""
+
+    __slots__ = (
+        "node", "is_async", "spawns", "awaited", "returned", "callbacked",
+        "waited", "wall_names",
+    )
+
+    def __init__(self, node: Optional[ast.AST], is_async: bool) -> None:
+        self.node = node
+        self.is_async = is_async
+        # (call node, binding) — binding is the assigned name/attr dotted
+        # string, "" for a bare-expression spawn, None for compliant shapes.
+        self.spawns: List[Tuple[ast.Call, Optional[str]]] = []
+        self.awaited: Set[str] = set()
+        self.returned: Set[str] = set()
+        self.callbacked: Set[str] = set()
+        self.waited: Set[str] = set()
+        self.wall_names: Set[str] = set()
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(
+        self,
+        path: str,
+        aliases: Dict[str, str],
+        jit_targets: Set[str],
+        metric_labels: Optional[Dict[str, Tuple[str, ...]]],
+    ) -> None:
+        self.path = path
+        self.aliases = aliases
+        self.jit_targets = jit_targets
+        self.metric_labels = metric_labels
+        self.findings: List[Finding] = []
+        self._scopes: List[_FunctionScope] = [_FunctionScope(None, False)]
+        self._class_locks: List[Set[str]] = []
+        self._held_locks: List[str] = []
+        self._method: List[str] = []
+        norm = path.replace(os.sep, "/")
+        self._jit_dir = any(f"/{d}/" in f"/{norm}" for d in JIT_PURITY_DIRS)
+
+    # -- helpers --
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path, node.lineno, node.col_offset, message)
+        )
+
+    def _dot(self, node: ast.AST) -> Optional[str]:
+        return _dotted(node, self.aliases)
+
+    @property
+    def _scope(self) -> _FunctionScope:
+        return self._scopes[-1]
+
+    def _is_spawn(self, call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Name):
+            resolved = self.aliases.get(func.id, func.id)
+            return resolved.rsplit(".", 1)[-1] in SPAWN_NAMES
+        if isinstance(func, ast.Attribute):
+            return func.attr in SPAWN_NAMES
+        return False
+
+    # -- scope / class structure --
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_locks.append(_collect_class_locks(node, self.aliases))
+        self.generic_visit(node)
+        self._class_locks.pop()
+
+    def _visit_function(self, node, is_async: bool) -> None:
+        jitted = self._jit_dir and (
+            node.name in self.jit_targets or _is_jit_decorated(node, self.aliases)
+        )
+        self._scopes.append(_FunctionScope(node, is_async))
+        self._method.append(node.name)
+        held, self._held_locks = self._held_locks, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self._held_locks = held
+        self._method.pop()
+        scope = self._scopes.pop()
+        self._finish_scope(scope)
+        if jitted:
+            self._check_jit_purity(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_function(node, is_async=True)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda's value is returned to its caller; ``call_later(...,
+        # lambda: ensure_future(c))`` discards the handle, so a spawn that IS
+        # the whole lambda body is an orphan.
+        body = node.body
+        if isinstance(body, ast.Call) and self._is_spawn(body):
+            self._scope.spawns.append((body, ""))
+            for arg in ast.iter_child_nodes(body):
+                self.visit(arg)
+        else:
+            self.generic_visit(node)
+
+    # -- statement-level contexts for the task-orphan rule --
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        value = node.value
+        if isinstance(value, ast.Call) and self._is_spawn(value):
+            self._scope.spawns.append((value, ""))
+            for child in ast.iter_child_nodes(value):
+                self.visit(child)
+            return
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        bindings: List[Optional[str]] = []
+        spawn_nodes: List[ast.Call] = []
+        if isinstance(value, ast.Call) and self._is_spawn(value):
+            spawn_nodes = [value]
+        elif isinstance(value, (ast.List, ast.Tuple)):
+            spawn_nodes = [
+                e for e in value.elts
+                if isinstance(e, ast.Call) and self._is_spawn(e)
+            ]
+        if spawn_nodes:
+            target = node.targets[0]
+            binding: Optional[str] = None
+            if isinstance(target, ast.Name):
+                binding = target.id
+            elif isinstance(target, ast.Attribute):
+                binding = self._dot(target)
+            for spawn in spawn_nodes:
+                self._scope.spawns.append((spawn, binding))
+            for spawn in spawn_nodes:
+                for child in ast.iter_child_nodes(spawn):
+                    self.visit(child)
+            for other in ast.iter_child_nodes(node):
+                if other is not value:
+                    self.visit(other)
+            self._note_wall_assign(node)
+            return
+        self._note_wall_assign(node)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        value = node.value
+        if isinstance(value, ast.Call) and self._is_spawn(value):
+            self._scope.spawns.append((value, None))  # handed to the caller
+            for child in ast.iter_child_nodes(value):
+                self.visit(child)
+            return
+        if isinstance(value, ast.Name):
+            self._scope.returned.add(value.id)
+        elif isinstance(value, ast.Attribute):
+            dotted = self._dot(value)
+            if dotted:
+                self._scope.returned.add(dotted)
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await) -> None:
+        value = node.value
+        if self._held_locks:
+            self._emit(
+                RULE_LOCK_DISCIPLINE,
+                node,
+                f"await while holding threading lock '{self._held_locks[-1]}' "
+                "(blocks the event loop; use the lock only around non-awaiting "
+                "critical sections)",
+            )
+        if isinstance(value, ast.Call) and self._is_spawn(value):
+            self._scope.spawns.append((value, None))  # awaited immediately
+            for child in ast.iter_child_nodes(value):
+                self.visit(child)
+            return
+        if isinstance(value, ast.Name):
+            self._scope.awaited.add(value.id)
+        elif isinstance(value, ast.Attribute):
+            dotted = self._dot(value)
+            if dotted:
+                self._scope.awaited.add(dotted)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        lock_attrs = self._class_locks[-1] if self._class_locks else set()
+        for item in node.items:
+            expr = item.context_expr
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in lock_attrs
+            ):
+                self._held_locks.append(expr.attr)
+                pushed += 1
+        for item in node.items:
+            self.visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self._held_locks.pop()
+
+    # -- calls: blocking-in-async, metrics labels, spawn args, callbacks --
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = self._dot(node.func) or ""
+        func = node.func
+
+        if isinstance(func, ast.Attribute):
+            if func.attr == "add_done_callback":
+                owner = self._dot(func.value)
+                if owner:
+                    self._scope.callbacked.add(owner)
+            if func.attr == "labels":
+                self._check_metric_labels(node, func)
+            if func.attr == "append" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call) and self._is_spawn(arg):
+                    # Appending straight into a task list stores the handle
+                    # but nobody ever awaits list members — exceptions are
+                    # swallowed until (at best) interpreter shutdown.
+                    self._scope.spawns.append((arg, ""))
+                    for child in ast.iter_child_nodes(arg):
+                        self.visit(child)
+                    for other in node.args[1:] + [kw.value for kw in node.keywords]:
+                        self.visit(other)
+                    self.visit(func.value)
+                    return
+
+        if self._scope.is_async:
+            self._check_async_blocking(node, dotted)
+
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail in _WAITER_SUFFIXES:
+            for arg in node.args:
+                self._note_waited(arg)
+
+        self._check_wall_clock_call(node)
+        self.generic_visit(node)
+
+    def _note_waited(self, arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name):
+            self._scope.waited.add(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            dotted = self._dot(arg)
+            if dotted:
+                self._scope.waited.add(dotted)
+        elif isinstance(arg, (ast.List, ast.Tuple, ast.Set)):
+            for e in arg.elts:
+                self._note_waited(e)
+        elif isinstance(arg, ast.Starred):
+            self._note_waited(arg.value)
+
+    def _check_async_blocking(self, node: ast.Call, dotted: str) -> None:
+        if dotted in BLOCKING_CALLS:
+            self._emit(
+                RULE_ASYNC_BLOCKING,
+                node,
+                f"blocking call {dotted}() inside async def "
+                f"{self._method[-1] if self._method else '<module>'} "
+                "(use asyncio equivalents or run_in_executor)",
+            )
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in BLOCKING_METHODS:
+            self._emit(
+                RULE_ASYNC_BLOCKING,
+                node,
+                f"synchronous accelerator dispatch .{func.attr}() called "
+                "directly from a coroutine (dispatch via run_in_executor so "
+                "the event loop never blocks on the device)",
+            )
+
+    # -- rule 3b: guarded-field mutation --
+
+    def _check_guarded_target(self, target: ast.AST, node: ast.AST) -> None:
+        if not (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and target.attr in GUARDED_FIELDS
+        ):
+            return
+        if self._method and self._method[-1] == "__init__":
+            return
+        lock = GUARDED_FIELDS[target.attr]
+        if lock not in self._held_locks:
+            self._emit(
+                RULE_LOCK_DISCIPLINE,
+                node,
+                f"shared field self.{target.attr} mutated outside its "
+                f"designated lock 'self.{lock}' (EMA/counter read-modify-"
+                "writes race across threads)",
+            )
+
+    # -- rule 5: wall-clock intervals --
+
+    def _note_wall_assign(self, node: ast.Assign) -> None:
+        value = node.value
+        if (
+            isinstance(value, ast.Call)
+            and self._dot(value.func) == "time.time"
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self._scope.wall_names.add(target.id)
+
+    def _is_wall_operand(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call) and self._dot(node.func) == "time.time":
+            return True
+        return isinstance(node, ast.Name) and node.id in self._scope.wall_names
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.Sub) and (
+            self._is_wall_operand(node.left) or self._is_wall_operand(node.right)
+        ):
+            self._emit(
+                RULE_WALL_CLOCK,
+                node,
+                "interval measured with time.time() (wall clock steps under "
+                "NTP; use time.monotonic() for durations)",
+            )
+        self.generic_visit(node)
+
+    def _check_wall_clock_call(self, node: ast.Call) -> None:
+        # AugAssign path (``acc -= time.time()``) is rare enough to skip; the
+        # assign+subtract idiom above covers this codebase.
+        return
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_guarded_target(node.target, node)
+        self.generic_visit(node)
+
+    def _visit_assign_guarded(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_guarded_target(target, node)
+
+    # -- rule 4: jit purity --
+
+    def _check_jit_purity(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "item" and not node.args:
+                self._emit(
+                    RULE_JIT_PURITY,
+                    node,
+                    ".item() inside a jit/pallas kernel forces a host sync "
+                    "per element (keep values on device)",
+                )
+                continue
+            dotted = self._dot(func) or ""
+            if dotted in JIT_IMPURE_CALLS:
+                self._emit(
+                    RULE_JIT_PURITY,
+                    node,
+                    f"{dotted}() inside a jit/pallas kernel (debug prints "
+                    "recompile and serialize the kernel; gate behind "
+                    "interpret mode)",
+                )
+            elif any(dotted.startswith(p) for p in JIT_IMPURE_PREFIXES):
+                self._emit(
+                    RULE_JIT_PURITY,
+                    node,
+                    f"host call {dotted}() inside a jit/pallas kernel "
+                    "(numpy/time run at trace time, not on device — use "
+                    "jax.numpy or hoist out of the kernel)",
+                )
+            elif isinstance(func, ast.Name) and func.id == "print":
+                self._emit(
+                    RULE_JIT_PURITY,
+                    node,
+                    "print() inside a jit/pallas kernel executes at trace "
+                    "time only (use jax.debug.print in interpret mode if "
+                    "needed)",
+                )
+
+    # -- rule 6: metrics label arity --
+
+    def _check_metric_labels(self, node: ast.Call, func: ast.Attribute) -> None:
+        if self.metric_labels is None:
+            return
+        owner = func.value
+        metric = None
+        if isinstance(owner, ast.Attribute):
+            metric = owner.attr
+        elif isinstance(owner, ast.Name):
+            metric = owner.id
+        if metric is None or metric not in self.metric_labels:
+            return
+        declared = self.metric_labels[metric]
+        given = len(node.args) + len(node.keywords)
+        kw_names = {kw.arg for kw in node.keywords if kw.arg}
+        if given != len(declared) or not kw_names.issubset(set(declared)):
+            self._emit(
+                RULE_METRICS_LABELS,
+                node,
+                f".labels() arity mismatch for series '{metric}': declared "
+                f"{list(declared)} in metrics.py, call passes {given} "
+                "label(s)",
+            )
+
+    # -- scope wrap-up --
+
+    def _finish_scope(self, scope: _FunctionScope) -> None:
+        supervised = scope.awaited | scope.returned | scope.callbacked | scope.waited
+        for call, binding in scope.spawns:
+            if binding is None:
+                continue  # awaited/returned at the spawn site
+            if binding and binding in supervised:
+                continue
+            where = f"bound to '{binding}'" if binding else "with a discarded handle"
+            self.findings.append(
+                Finding(
+                    RULE_TASK_ORPHAN,
+                    self.path,
+                    call.lineno,
+                    call.col_offset,
+                    f"fire-and-forget task {where}: the handle is never "
+                    "awaited and has no exception-logging done-callback — "
+                    "exceptions are silently swallowed (use "
+                    "utils.tasks.spawn_logged)",
+                )
+            )
+
+    # Route Assign through both the spawn tracking above and rule 3b.
+    def generic_visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            self._visit_assign_guarded(node)
+        super().generic_visit(node)
+
+
+def analyze_source(
+    source: str,
+    path: str,
+    metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None,
+) -> List[Finding]:
+    """Run all six rules over one module's source; returns findings with
+    inline ``# lint: ignore[...]`` suppressions already applied."""
+    tree = ast.parse(source, filename=path)
+    aliases = _collect_aliases(tree)
+    jit_targets = _collect_jit_targets(tree, aliases)
+    checker = _Checker(path, aliases, jit_targets, metric_labels)
+    # Rule 3b must also see module-level and __init__ assigns routed through
+    # generic_visit; the NodeVisitor dispatch handles the rest.
+    checker.visit(tree)
+    suppressed = _suppressions(source)
+    out: List[Finding] = []
+    for f in checker.findings:
+        rules = None
+        hit = False
+        for line in (f.line, f.line - 1):
+            if line in suppressed:
+                rules = suppressed[line]
+                if rules is None or f.rule in rules:
+                    hit = True
+                break
+        if not hit:
+            out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def analyze_file(
+    path: str,
+    root: Optional[str] = None,
+    metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None,
+) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    return analyze_source(source, rel.replace(os.sep, "/"), metric_labels)
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames if not d.startswith((".", "__pycache__"))]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def analyze_paths(
+    paths: Sequence[str], root: Optional[str] = None
+) -> List[Finding]:
+    """Analyze every ``.py`` under ``paths``; the metrics-label registry is
+    built from the first ``metrics.py`` encountered in the scanned set."""
+    files = list(_iter_py_files(paths))
+    metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None
+    for path in files:
+        if os.path.basename(path) == "metrics.py":
+            with open(path, "r", encoding="utf-8") as fh:
+                metric_labels = collect_metric_labels(ast.parse(fh.read()))
+            break
+    findings: List[Finding] = []
+    for path in files:
+        findings.extend(analyze_file(path, root=root, metric_labels=metric_labels))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# -- baseline -----------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    payload = {
+        "comment": (
+            "mysticeti-lint baseline: pre-existing findings tolerated at "
+            "CI-gate time. Regenerate with `python -m mysticeti_tpu.analysis "
+            "--baseline-regen` (or tools/lint.py --baseline-regen) after "
+            "deliberate changes; prefer fixing or inline-ignoring over "
+            "baselining."
+        ),
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def new_findings(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> List[Finding]:
+    """Findings beyond the baselined count per fingerprint (zero-new gate)."""
+    budget = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out

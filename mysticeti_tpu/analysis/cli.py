@@ -1,0 +1,119 @@
+"""Command-line front end: ``python -m mysticeti_tpu.analysis`` (and the
+``tools/lint.py`` alias).
+
+Exit codes: 0 = clean (no new findings beyond the baseline), 1 = new
+findings, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .checker import (
+    RULES,
+    analyze_paths,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+_PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PACKAGE_ROOT)
+DEFAULT_BASELINE = os.path.join(
+    _PACKAGE_ROOT, "analysis", "baseline.json"
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m mysticeti_tpu.analysis",
+        description=(
+            "mysticeti-lint: AST invariant checker (async-safety, lock "
+            "discipline, JAX kernel purity, wall-clock use, metrics labels)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to analyze (default: the mysticeti_tpu package)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file of tolerated findings (default: analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding; ignore the baseline",
+    )
+    parser.add_argument(
+        "--baseline-regen",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in RULES:
+            print(rule)
+        return 0
+
+    paths: List[str] = list(args.paths) or [_PACKAGE_ROOT]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+
+    findings = analyze_paths(paths, root=_REPO_ROOT)
+
+    if args.baseline_regen:
+        write_baseline(args.baseline, findings)
+        print(
+            f"baseline regenerated with {len(findings)} finding(s) -> "
+            f"{os.path.relpath(args.baseline, _REPO_ROOT)}"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    fresh = new_findings(findings, baseline)
+
+    if args.as_json:
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "col": f.col,
+                        "message": f.message,
+                    }
+                    for f in fresh
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in fresh:
+            print(f.render())
+        baselined = len(findings) - len(fresh)
+        tail = f" ({baselined} baselined)" if baselined else ""
+        print(
+            f"mysticeti-lint: {len(fresh)} new finding(s) over "
+            f"{len(paths)} path(s){tail}"
+        )
+    return 1 if fresh else 0

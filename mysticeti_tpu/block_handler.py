@@ -23,6 +23,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from .committee import Committee, QUORUM, TransactionAggregator
 from .log import TransactionLog
+from .runtime import now as runtime_now
 from .serde import Reader, Writer
 from .types import (
     AuthorityIndex,
@@ -184,7 +185,11 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         if require_response:
             while (received := self._receive_with_limit()) is not None:
                 response.extend(Share(tx) for tx in received)
-        now = time.time()
+        # transaction_time stamps are local to this process (own proposals),
+        # so certify latency is an interval on the runtime clock — monotonic
+        # in production (an NTP step must not dent the latency channels) and
+        # virtual under the DeterministicLoop simulator.
+        now = runtime_now()
         for block in blocks:
             if self.consensus_only:
                 continue
@@ -233,9 +238,10 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         if n_shared:
             # One stamp per OWN proposal: every share of the block was
             # drained at the same moment, so per-transaction stamps (a dict
-            # entry per tx) carried no information — only cost.
+            # entry per tx) carried no information — only cost.  Runtime
+            # clock: every reader measures an interval in this same process.
             with self._time_lock:
-                self.transaction_time[block.reference] = time.time()
+                self.transaction_time[block.reference] = runtime_now()
         if not self.consensus_only:
             from .committee import shared_ranges
 
@@ -257,7 +263,7 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
     TRANSACTION_TIME_RETENTION_S = 120.0
 
     def cleanup(self) -> None:
-        cutoff = time.time() - self.TRANSACTION_TIME_RETENTION_S
+        cutoff = runtime_now() - self.TRANSACTION_TIME_RETENTION_S
         with self._time_lock:
             # Mutate IN PLACE: the commit observer shares this dict
             # (validator.py wires handler.transaction_time into

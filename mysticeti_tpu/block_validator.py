@@ -25,6 +25,7 @@ from typing import List, Optional, Sequence, Tuple
 from .committee import Committee
 from .tracing import logger
 from .types import StatementBlock, VerificationError
+from .utils.tasks import spawn_logged
 
 log = logger(__name__)
 
@@ -644,7 +645,7 @@ class BatchedSignatureVerifier(BlockVerifier):
             elif self._flush_task is None:
                 self._flush_task = loop.call_later(
                     self._effective_delay_s(),
-                    lambda: asyncio.ensure_future(self._flush()),
+                    lambda: spawn_logged(self._flush(), log, name="verify-flush"),
                 )
         if flush_now:
             await self._flush()
@@ -695,11 +696,16 @@ class BatchedSignatureVerifier(BlockVerifier):
 
             started = time.monotonic()
             out, label = await loop.run_in_executor(None, _dispatch)
-            self._dispatch_ema_s = _update_ema(
-                self._dispatch_ema_s,
-                time.monotonic() - started,
-                self.EMA_OUTLIER_S,
-            )
+            # The window EMA shares self._lock with the pending queue: the
+            # read-modify-write must not interleave with _effective_delay_s
+            # readers scheduling a flush from another flush's critical
+            # section.
+            with self._lock:
+                self._dispatch_ema_s = _update_ema(
+                    self._dispatch_ema_s,
+                    time.monotonic() - started,
+                    self.EMA_OUTLIER_S,
+                )
             # Backend counters measure ACTUAL dispatches: counted here, per
             # dispatch, so aggregate-skipped blocks never inflate them.
             if self.metrics is not None:
@@ -794,7 +800,7 @@ class BatchedSignatureVerifier(BlockVerifier):
                 if self._flush_task is None:
                     self._flush_task = loop.call_later(
                         self._effective_delay_s(),
-                        lambda: asyncio.ensure_future(self._flush()),
+                        lambda: spawn_logged(self._flush(), log, name="verify-flush"),
                     )
         return results
 

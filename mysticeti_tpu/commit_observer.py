@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional
 from .block_store import BlockStore
 from .committee import Committee, QUORUM, TransactionAggregator
 from .consensus.linearizer import CommittedSubDag, Linearizer
+from .runtime import now as runtime_now
 from .state import CommitObserverRecoveredState
 from .types import BlockReference, StatementBlock
 
@@ -80,7 +81,13 @@ class TestCommitObserver(CommitObserver):
         self.commit_interpreter.recover_state(recovered)
 
     def handle_commit(self, committed_leaders):
-        now = time.time()
+        # transaction_time stamps (shared with the block handler) are on the
+        # runtime clock (monotonic in production, virtual under the
+        # simulator), same-process: certificate intervals read the same
+        # source.  Generator-embedded stamps are wall-clock by design
+        # (cross-process) and are read with time.time() at the batch-metrics
+        # call below.
+        now = runtime_now()
         committed = self.commit_interpreter.handle_commit(committed_leaders)
         stamps: List[bytes] = []
         for commit in committed:
@@ -123,7 +130,9 @@ class TestCommitObserver(CommitObserver):
                         )
         heads = b"".join(stamps)
         if heads:
-            self._update_metrics_batch(heads, now)
+            # Wall clock on purpose: the generator's embedded submission
+            # stamps are wall-clock floats shared across processes.
+            self._update_metrics_batch(heads, time.time())
         return committed
 
     def _update_metrics_batch(self, heads: bytes, now: float) -> None:

@@ -11,19 +11,33 @@ Mirrors the capability surface of ``mysticeti-core/src/crypto.rs``:
   property to drop already-covered items from a batch.
 
 The CPU path here uses ``hashlib.blake2b`` and the ``cryptography`` library's Ed25519
-(the correctness oracle).  The TPU path lives in ``mysticeti_tpu.ops`` and is checked
-against this module bit-for-bit (accept/reject parity) by the test suite.
+(the correctness oracle) when that package is installed; otherwise the pure-Python
+RFC 8032 implementation in :mod:`mysticeti_tpu._ed25519_py` fills in with the same
+class surface and the same strict accept/reject semantics.  The TPU path lives in
+``mysticeti_tpu.ops`` and is checked against this module bit-for-bit (accept/reject
+parity) by the test suite.
 """
 from __future__ import annotations
 
 import hashlib
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # optional fast path absent: pure-Python oracle
+    from ._ed25519_py import (  # type: ignore[assignment]
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        InvalidSignature,
+    )
+
+    HAVE_CRYPTOGRAPHY = False
 
 DIGEST_SIZE = 32
 SIGNATURE_SIZE = 64

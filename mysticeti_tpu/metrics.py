@@ -28,6 +28,11 @@ from prometheus_client import (
     generate_latest,
 )
 
+from .tracing import logger
+from .utils.tasks import spawn_logged
+
+log = logger(__name__)
+
 LATENCY_SEC_BUCKETS = [
     0.1, 0.25, 0.5, 0.75, 1.0, 1.25, 1.5, 1.75, 2.0, 2.5, 5.0, 10.0, 20.0,
     30.0, 60.0, 90.0,
@@ -337,7 +342,7 @@ class MetricReporter:
         self._task: Optional[asyncio.Task] = None
 
     def start(self) -> "MetricReporter":
-        self._task = asyncio.ensure_future(self._run())
+        self._task = spawn_logged(self._run(), log, name="metric-reporter")
         return self
 
     async def _run(self) -> None:

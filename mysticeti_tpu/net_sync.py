@@ -36,6 +36,7 @@ from .network import (
 )
 from .syncer import Syncer, SyncerSignals
 from .tracing import logger
+from .utils.tasks import spawn_logged
 
 log = logger(__name__)
 from .synchronizer import BlockDisseminator, BlockFetcher
@@ -136,11 +137,11 @@ class NetworkSyncer:
         self.connected_authorities.insert(self.core.authority)
         # Initial proposal attempt (validator genesis kick, net_sync.rs:97).
         await self.dispatcher.force_new_block(1, self.connected_authorities.copy())
-        self._tasks.append(asyncio.ensure_future(self._accept_loop()))
-        self._tasks.append(asyncio.ensure_future(self._leader_timeout_task()))
-        self._tasks.append(asyncio.ensure_future(self._cleanup_task()))
+        self._tasks.append(spawn_logged(self._accept_loop(), log))
+        self._tasks.append(spawn_logged(self._leader_timeout_task(), log))
+        self._tasks.append(spawn_logged(self._cleanup_task(), log))
         if self.parameters.rounds_in_epoch < ROUNDS_IN_EPOCH_MAX:
-            self._tasks.append(asyncio.ensure_future(self._epoch_watch_task()))
+            self._tasks.append(spawn_logged(self._epoch_watch_task(), log))
         self.fetcher.start()
         if self._start_wal_sync_thread:
             self._start_wal_syncer()
@@ -195,7 +196,7 @@ class NetworkSyncer:
         while True:
             connection: Connection = await self.network.connections.get()
             self._tasks.append(
-                asyncio.ensure_future(self._connection_task(connection))
+                spawn_logged(self._connection_task(connection), log)
             )
 
     # Max verification groups in flight per connection: deep enough that a
@@ -251,6 +252,8 @@ class NetworkSyncer:
                     if verified:
                         refs = [b.reference.digest for b in verified]
                         inflight.update(refs)
+                        # Awaited in stream order by _accept_ordered, which
+                        # observes its exception.  # lint: ignore[task-orphan]
                         fut = asyncio.ensure_future(
                             self._verify_accepted(verified)
                         )

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .serde import Reader, SerdeError, Writer
 from .tracing import logger
+from .utils.tasks import spawn_logged
 from .types import BlockReference, RoundNumber, StatementBlock
 
 log = logger(__name__)
@@ -240,7 +241,9 @@ class TcpNetwork:
         # Dial every higher-index peer; lower-index peers dial us.
         for peer in range(len(addresses)):
             if peer > authority:
-                net._tasks.append(asyncio.ensure_future(net._dial_worker(peer)))
+                net._tasks.append(
+                    spawn_logged(net._dial_worker(peer), log, name=f"dial {peer}")
+                )
         return net
 
     # -- inbound --

@@ -16,6 +16,10 @@ import asyncio
 from typing import Dict, List, Optional, Set, Tuple
 
 from .network import Connection, NetworkMessage
+from .tracing import logger
+from .utils.tasks import spawn_logged
+
+log = logger(__name__)
 
 
 class SimulatedNetwork:
@@ -38,8 +42,8 @@ class SimulatedNetwork:
     async def _connect_pair(self, a: int, b: int) -> None:
         ca = Connection(b)  # a's handle, peer=b
         cb = Connection(a)
-        pump_a = asyncio.ensure_future(self._pump(a, b, ca, cb))
-        pump_b = asyncio.ensure_future(self._pump(b, a, cb, ca))
+        pump_a = spawn_logged(self._pump(a, b, ca, cb), log, name=f"pump {a}->{b}")
+        pump_b = spawn_logged(self._pump(b, a, cb, ca), log, name=f"pump {b}->{a}")
         self._links[(a, b)] = (ca, cb, pump_a, pump_b)
         await self.node_connections[a].put(ca)
         await self.node_connections[b].put(cb)

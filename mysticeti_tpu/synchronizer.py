@@ -18,6 +18,7 @@ from .block_store import BlockStore
 from .config import SynchronizerParameters
 from .core_task import CoreTaskDispatcher
 from .tracing import logger
+from .utils.tasks import spawn_logged
 from .network import (
     BlockNotFound,
     Blocks,
@@ -56,7 +57,7 @@ class BlockDisseminator:
         """Peer asked for our blocks starting after ``from_round``."""
         if self._stream_task is not None:
             self._stream_task.cancel()
-        self._stream_task = asyncio.ensure_future(self._stream_own(from_round))
+        self._stream_task = spawn_logged(self._stream_own(from_round), log)
 
     async def _stream_own(self, from_round: RoundNumber) -> None:
         """Push loop (synchronizer.rs:131-164): batch, send, wait for new blocks."""
@@ -121,7 +122,7 @@ class BlockFetcher:
         self._task: Optional[asyncio.Task] = None
 
     def start(self) -> "BlockFetcher":
-        self._task = asyncio.ensure_future(self._run())
+        self._task = spawn_logged(self._run(), log)
         return self
 
     async def _run(self) -> None:

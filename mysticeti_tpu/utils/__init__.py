@@ -1,0 +1,3 @@
+from .tasks import spawn_logged
+
+__all__ = ["spawn_logged"]

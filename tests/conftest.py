@@ -28,3 +28,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # compile on CPU; cache it across test sessions.
 # Persistent compilation cache: mysticeti_tpu.ops.ed25519 sets a per-uid,
 # ownership-checked default when JAX_COMPILATION_CACHE_DIR is unset.
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    # pytest.ini declares kernel tests tier 2 ("JAX kernel/mesh compile-heavy
+    # tests (minutes; run tier 2)"); the tier-1 gate selects `-m 'not slow'`.
+    # Marking kernel items slow here enforces that declared tiering — a cold
+    # compilation cache otherwise blows the tier-1 wall-time budget — while
+    # `-m kernel` still selects them for the tier-2 run.
+    for item in items:
+        if "kernel" in item.keywords:
+            item.add_marker(pytest.mark.slow)

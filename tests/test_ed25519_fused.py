@@ -12,10 +12,10 @@ import pytest
 
 pytestmark = pytest.mark.kernel
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+from mysticeti_tpu.crypto import (
     Ed25519PrivateKey,
     Ed25519PublicKey,
+    InvalidSignature,
 )
 
 from mysticeti_tpu.ops import ed25519 as E

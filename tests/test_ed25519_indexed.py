@@ -10,7 +10,7 @@ import random
 
 import numpy as np
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from mysticeti_tpu.crypto import Ed25519PrivateKey
 
 from mysticeti_tpu.ops import ed25519 as E
 

@@ -9,7 +9,7 @@ import random
 
 import numpy as np
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from mysticeti_tpu.crypto import Ed25519PrivateKey
 
 from mysticeti_tpu.ops import ed25519 as E
 
@@ -93,7 +93,7 @@ def test_keyed_kernel_matches_oracle(keyring):
     )[:n]
     assert (out == expect).all()
     # parity with the CPU oracle
-    from cryptography.exceptions import InvalidSignature
+    from mysticeti_tpu.crypto import InvalidSignature
 
     for i in range(n):
         try:

@@ -12,7 +12,7 @@ import pytest
 
 pytestmark = pytest.mark.kernel
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from mysticeti_tpu.crypto import Ed25519PrivateKey
 
 from mysticeti_tpu.ops import ed25519 as E
 from mysticeti_tpu.ops import ed25519_pallas as EP

@@ -10,8 +10,7 @@ pytestmark = pytest.mark.kernel
 
 import jax.numpy as jnp
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from mysticeti_tpu.crypto import Ed25519PrivateKey, InvalidSignature
 
 from mysticeti_tpu.ops import ed25519 as E
 from mysticeti_tpu.ops import field as F
@@ -160,7 +159,7 @@ def test_rfc8032_corrupted():
 
 
 def _oracle_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+    from mysticeti_tpu.crypto import Ed25519PublicKey
 
     try:
         Ed25519PublicKey.from_public_bytes(pk).verify(sig, msg)

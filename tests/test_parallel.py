@@ -4,7 +4,7 @@ import pytest
 
 pytestmark = pytest.mark.kernel
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from mysticeti_tpu.crypto import Ed25519PrivateKey
 
 import jax
 
@@ -47,7 +47,7 @@ def test_sharded_fused_verify_matches_oracle():
     import random
 
     import numpy as np
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+    from mysticeti_tpu.crypto import Ed25519PrivateKey
 
     from mysticeti_tpu.parallel import make_mesh, sharded_verify_batch_fused
 

@@ -1,5 +1,9 @@
 """RangeMap tests — property-based coverage mirroring range_map.rs's unit tests,
 checked against a naive dict-of-points model."""
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -6,10 +6,12 @@ payloads, and total rejection of truncation/trailing garbage (a malformed
 frame must raise SerdeError, never mis-decode — consensus reads untrusted
 bytes off the network, types.rs:315-347 path).
 """
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
-
-import pytest
 
 from mysticeti_tpu.committee import Committee
 from mysticeti_tpu.serde import Reader, SerdeError, Writer

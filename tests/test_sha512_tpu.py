@@ -26,8 +26,6 @@ def test_sha512_96_matches_hashlib():
 
 def test_sha512_96_is_the_ed25519_challenge_shape():
     """The exact production shape: R || A || blake2b-256 block digest."""
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
-
     from mysticeti_tpu import crypto
     from mysticeti_tpu.types import StatementBlock
 

@@ -1,0 +1,412 @@
+"""mysticeti-lint: per-rule positive/negative fixtures + the repo gate.
+
+Every rule must (a) catch its fixture violation and (b) stay silent on the
+compliant twin — a rule that can't tell the two apart enforces nothing.
+The final tests run the analyzer over the real package (in-process and via
+the ``python -m mysticeti_tpu.analysis`` CLI, the tier-1 CI registration)
+and require zero non-baselined findings.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from mysticeti_tpu.analysis import (
+    RULES,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mysticeti_tpu")
+BASELINE = os.path.join(PKG, "analysis", "baseline.json")
+
+
+def run(src, path="mysticeti_tpu/example.py", **kw):
+    return analyze_source(textwrap.dedent(src), path, **kw)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- rule 1: async-blocking ---------------------------------------------------
+
+def test_async_blocking_positive_sleep():
+    findings = run(
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        """
+    )
+    assert rules_of(findings) == ["async-blocking"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_async_blocking_positive_direct_dispatch():
+    findings = run(
+        """
+        async def flush(verifier, pks, digests, sigs):
+            return verifier.verify_signatures(pks, digests, sigs)
+        """
+    )
+    assert rules_of(findings) == ["async-blocking"]
+    assert "verify_signatures" in findings[0].message
+
+
+def test_async_blocking_negative():
+    findings = run(
+        """
+        import asyncio
+        import time
+
+        async def handler(loop, verifier, pks, digests, sigs):
+            await asyncio.sleep(0.1)
+
+            def _dispatch():
+                # sync nested fn: runs in the executor, not the loop
+                return verifier.verify_signatures(pks, digests, sigs)
+
+            return await loop.run_in_executor(None, _dispatch)
+
+        def sync_path():
+            time.sleep(0.1)  # blocking is fine outside coroutines
+        """
+    )
+    assert findings == []
+
+
+# -- rule 2: task-orphan ------------------------------------------------------
+
+def test_task_orphan_positive_shapes():
+    findings = run(
+        """
+        import asyncio
+
+        class Node:
+            def start_discarded(self):
+                asyncio.ensure_future(self._run())
+
+            def start_attr(self):
+                self._task = asyncio.ensure_future(self._run())
+
+            def start_appended(self, loop):
+                self._tasks.append(loop.create_task(self._run()))
+
+            def start_lambda(self, loop):
+                loop.call_later(1.0, lambda: asyncio.ensure_future(self._run()))
+        """
+    )
+    assert [f.rule for f in findings] == ["task-orphan"] * 4
+
+
+def test_task_orphan_negative_shapes():
+    findings = run(
+        """
+        import asyncio
+
+        class Node:
+            def start_supervised(self):
+                self._task = asyncio.ensure_future(self._run())
+                self._task.add_done_callback(self._on_done)
+
+            async def awaited(self):
+                task = asyncio.ensure_future(self._run())
+                return await task
+
+            async def raced(self):
+                first = asyncio.ensure_future(self._recv())
+                second = asyncio.ensure_future(self._closed.wait())
+                done, pending = await asyncio.wait({first, second})
+
+            def handed_to_caller(self):
+                return asyncio.ensure_future(self._run())
+
+            def via_helper(self, log):
+                self._task = spawn_logged(self._run(), log)
+        """
+    )
+    assert findings == []
+
+
+# -- rule 3: lock-discipline --------------------------------------------------
+
+def test_lock_discipline_positive_await_under_lock():
+    findings = run(
+        """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def flush(self):
+                with self._lock:
+                    await self._dispatch()
+        """
+    )
+    assert rules_of(findings) == ["lock-discipline"]
+    assert "await while holding" in findings[0].message
+
+
+def test_lock_discipline_positive_guarded_field():
+    findings = run(
+        """
+        import threading
+
+        class Hybrid:
+            def __init__(self):
+                self._ema_lock = threading.Lock()
+                self.cpu_per_sig_s = 0.0  # __init__ is exempt
+
+            def observe(self, sample):
+                self.cpu_per_sig_s = 0.8 * self.cpu_per_sig_s + 0.2 * sample
+        """
+    )
+    assert rules_of(findings) == ["lock-discipline"]
+    assert "cpu_per_sig_s" in findings[0].message
+
+
+def test_lock_discipline_negative():
+    findings = run(
+        """
+        import asyncio
+        import threading
+
+        class Hybrid:
+            def __init__(self):
+                self._ema_lock = threading.Lock()
+                self._alock = asyncio.Lock()
+                self.cpu_per_sig_s = 0.0
+
+            def observe(self, sample):
+                with self._ema_lock:
+                    self.cpu_per_sig_s = 0.8 * self.cpu_per_sig_s + 0.2 * sample
+
+            async def async_section(self):
+                async with self._alock:
+                    await self._dispatch()
+        """
+    )
+    assert findings == []
+
+
+# -- rule 4: jit-purity -------------------------------------------------------
+
+_JIT_FIXTURE = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @jax.jit
+    def kernel(x):
+        jax.debug.print("x = {}", x)
+        return np.asarray(x) + 1
+
+
+    @functools.partial(jax.jit, static_argnames=("tile",))
+    def tiled(x, tile):
+        return jnp.sum(x) + x.item()
+
+
+    def wrapped_impl(x):
+        print(x)
+        return x
+
+    wrapped = jax.jit(wrapped_impl)
+"""
+
+
+def test_jit_purity_positive_in_ops():
+    findings = run(_JIT_FIXTURE, path="mysticeti_tpu/ops/fake_kernel.py")
+    assert [f.rule for f in findings] == ["jit-purity"] * 4
+    messages = " ".join(f.message for f in findings)
+    assert "jax.debug.print" in messages
+    assert ".item()" in messages
+    assert "numpy.asarray" in messages
+    assert "print()" in messages
+
+
+def test_jit_purity_negative():
+    # Same host-impure code OUTSIDE ops/ and parallel/: other rules own the
+    # generic paths; jit purity is scoped to kernel directories.
+    assert run(_JIT_FIXTURE, path="mysticeti_tpu/example.py") == []
+    # Pure jnp kernels in ops/ are clean.
+    clean = run(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return jnp.sum(x * x)
+        """,
+        path="mysticeti_tpu/ops/fake_kernel.py",
+    )
+    assert clean == []
+
+
+# -- rule 5: wall-clock -------------------------------------------------------
+
+def test_wall_clock_positive():
+    findings = run(
+        """
+        import time
+
+        def measure(work):
+            started = time.time()
+            work()
+            return time.time() - started
+        """
+    )
+    assert rules_of(findings) == ["wall-clock"]
+
+
+def test_wall_clock_negative():
+    findings = run(
+        """
+        import time
+
+        def measure(work):
+            started = time.monotonic()
+            work()
+            return time.monotonic() - started
+
+        def stamp():
+            # Timestamping (no interval arithmetic) is the wall clock's job.
+            return time.time()
+        """
+    )
+    assert findings == []
+
+
+# -- rule 6: metrics-labels ---------------------------------------------------
+
+_METRIC_LABELS = {"verified_signatures_total": ("backend", "outcome")}
+
+
+def test_metrics_labels_positive():
+    findings = run(
+        """
+        class Verifier:
+            def count(self, n):
+                self.metrics.verified_signatures_total.labels("tpu").inc(n)
+        """,
+        metric_labels=_METRIC_LABELS,
+    )
+    assert rules_of(findings) == ["metrics-labels"]
+    assert "verified_signatures_total" in findings[0].message
+
+
+def test_metrics_labels_negative():
+    findings = run(
+        """
+        class Verifier:
+            def count(self, n):
+                self.metrics.verified_signatures_total.labels("tpu", "accepted").inc(n)
+                self.other_series.labels("anything")  # undeclared: skipped
+        """,
+        metric_labels=_METRIC_LABELS,
+    )
+    assert findings == []
+
+
+# -- suppressions and baseline ------------------------------------------------
+
+def test_inline_suppression_matches_rule():
+    src = """
+        import time
+
+        async def handler():
+            time.sleep(0.1)  # lint: ignore[async-blocking]
+    """
+    assert run(src) == []
+    # A suppression naming a DIFFERENT rule does not silence the finding.
+    wrong = src.replace("async-blocking", "wall-clock")
+    assert rules_of(run(wrong)) == ["async-blocking"]
+
+
+def test_baseline_tolerates_exactly_the_recorded_count(tmp_path):
+    src = """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+    """
+    found = run(src)
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, found)
+    baseline = load_baseline(path)
+    assert new_findings(found, baseline) == []
+    # A second identical violation exceeds the baselined count.
+    doubled = run(
+        src
+        + """
+        async def handler2():
+            time.sleep(0.2)
+    """
+    )
+    assert len(new_findings(doubled, baseline)) == 1
+
+
+# -- the repo gate (tier-1) ---------------------------------------------------
+
+def test_package_has_zero_nonbaselined_findings():
+    findings = analyze_paths([PKG], root=REPO)
+    fresh = new_findings(findings, load_baseline(BASELINE))
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+
+
+def test_cli_gate_exits_zero():
+    """The CI registration: `python -m mysticeti_tpu.analysis` must gate at
+    zero new findings on the committed tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mysticeti_tpu.analysis"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_and_rule_listing():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mysticeti_tpu.analysis", "--list-rules"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert set(proc.stdout.split()) == set(RULES)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mysticeti_tpu.analysis", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout) == []
+
+
+def test_lint_tool_alias():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), "--list-rules"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert set(proc.stdout.split()) == set(RULES)

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Thin CLI alias for the invariant checker.
+
+    python tools/lint.py                  # gate: exit 1 on new findings
+    python tools/lint.py --no-baseline    # show everything
+    python tools/lint.py --baseline-regen # refresh analysis/baseline.json
+
+Equivalent to ``python -m mysticeti_tpu.analysis``; see
+docs/static-analysis.md for the rule catalog.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mysticeti_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
