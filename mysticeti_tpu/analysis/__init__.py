@@ -4,7 +4,7 @@ The hardest correctness rules in this repository were, until this package,
 encoded only in comments — "the backend label must be captured in the same
 thread as the dispatch" (block_validator.py), "EMA read-modify-writes happen
 from executor threads; serialize them", "the device dispatch runs in a worker
-thread so the event loop never blocks".  This package mechanizes them as six
+thread so the event loop never blocks".  This package mechanizes them as
 stdlib-``ast`` rules, runnable as ``python -m mysticeti_tpu.analysis``:
 
 * ``async-blocking``   — blocking call (``time.sleep``, sync subprocess/socket
@@ -24,6 +24,10 @@ stdlib-``ast`` rules, runnable as ``python -m mysticeti_tpu.analysis``:
   ``time.monotonic()`` is required (wall clock steps under NTP).
 * ``metrics-labels``   — every ``.labels(...)`` call site must match the
   arity/names declared for that series in ``metrics.py``.
+* ``span-names``       — every literal stage passed to the span-tracer call
+  surface (``span``/``begin_span``/``end_span``/``record_span``) must come
+  from the central registry ``spans.STAGES`` (a typo'd stage silently never
+  matches its begin/end and vanishes from traces).
 
 Exit status: 0 = no new findings, 1 = new findings (or bad usage: 2).
 Deliberate exceptions carry an inline ``# lint: ignore[rule]`` suppression;

@@ -25,6 +25,7 @@ RULE_LOCK_DISCIPLINE = "lock-discipline"
 RULE_JIT_PURITY = "jit-purity"
 RULE_WALL_CLOCK = "wall-clock"
 RULE_METRICS_LABELS = "metrics-labels"
+RULE_SPAN_NAMES = "span-names"
 
 RULES = (
     RULE_ASYNC_BLOCKING,
@@ -33,6 +34,7 @@ RULES = (
     RULE_JIT_PURITY,
     RULE_WALL_CLOCK,
     RULE_METRICS_LABELS,
+    RULE_SPAN_NAMES,
 )
 
 # -- rule configuration -------------------------------------------------------
@@ -81,6 +83,11 @@ JIT_IMPURE_CALLS = {
     "jax.debug.breakpoint",
 }
 JIT_IMPURE_PREFIXES = ("numpy.", "time.")
+
+# Rule 7: span-tracer call surface.  A stage-name typo at an instrumentation
+# site silently splits (begin under one name, end under another: the span
+# never closes) — every literal stage must come from spans.STAGES.
+SPAN_CALL_NAMES = {"span", "begin_span", "end_span", "record_span"}
 
 _IGNORE_RE = re.compile(r"#\s*lint:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
 
@@ -231,6 +238,22 @@ def collect_metric_labels(tree: ast.Module) -> Dict[str, Tuple[str, ...]]:
     return declared
 
 
+def collect_span_stages(tree: ast.Module) -> Optional[Tuple[str, ...]]:
+    """The central stage registry from spans.py's ``STAGES = ("...", ...)``
+    literal-tuple assignment (kept literal precisely so this parse works)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == "STAGES":
+                if isinstance(node.value, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in node.value.elts
+                ):
+                    return tuple(e.value for e in node.value.elts)
+    return None
+
+
 def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> suppressed rule set (None = all rules)."""
     out: Dict[int, Optional[Set[str]]] = {}
@@ -273,11 +296,13 @@ class _Checker(ast.NodeVisitor):
         aliases: Dict[str, str],
         jit_targets: Set[str],
         metric_labels: Optional[Dict[str, Tuple[str, ...]]],
+        span_stages: Optional[Tuple[str, ...]] = None,
     ) -> None:
         self.path = path
         self.aliases = aliases
         self.jit_targets = jit_targets
         self.metric_labels = metric_labels
+        self.span_stages = span_stages
         self.findings: List[Finding] = []
         self._scopes: List[_FunctionScope] = [_FunctionScope(None, False)]
         self._class_locks: List[Set[str]] = []
@@ -477,6 +502,8 @@ class _Checker(ast.NodeVisitor):
                     self.visit(func.value)
                     return
 
+        self._check_span_name(node)
+
         if self._scope.is_async:
             self._check_async_blocking(node, dotted)
 
@@ -626,6 +653,31 @@ class _Checker(ast.NodeVisitor):
                     "needed)",
                 )
 
+    # -- rule 7: span stage names --
+
+    def _check_span_name(self, node: ast.Call) -> None:
+        if self.span_stages is None:
+            return
+        func = node.func
+        name = None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name not in SPAN_CALL_NAMES or not node.args:
+            return
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            return  # computed stage: not statically checkable, skip
+        if first.value not in self.span_stages:
+            self._emit(
+                RULE_SPAN_NAMES,
+                node,
+                f"span stage '{first.value}' is not in the central registry "
+                "spans.STAGES (a typo'd stage silently never matches its "
+                "begin/end and disappears from traces)",
+            )
+
     # -- rule 6: metrics label arity --
 
     def _check_metric_labels(self, node: ast.Call, func: ast.Attribute) -> None:
@@ -685,13 +737,14 @@ def analyze_source(
     source: str,
     path: str,
     metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None,
+    span_stages: Optional[Tuple[str, ...]] = None,
 ) -> List[Finding]:
-    """Run all six rules over one module's source; returns findings with
+    """Run all rules over one module's source; returns findings with
     inline ``# lint: ignore[...]`` suppressions already applied."""
     tree = ast.parse(source, filename=path)
     aliases = _collect_aliases(tree)
     jit_targets = _collect_jit_targets(tree, aliases)
-    checker = _Checker(path, aliases, jit_targets, metric_labels)
+    checker = _Checker(path, aliases, jit_targets, metric_labels, span_stages)
     # Rule 3b must also see module-level and __init__ assigns routed through
     # generic_visit; the NodeVisitor dispatch handles the rest.
     checker.visit(tree)
@@ -715,11 +768,14 @@ def analyze_file(
     path: str,
     root: Optional[str] = None,
     metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None,
+    span_stages: Optional[Tuple[str, ...]] = None,
 ) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
     rel = os.path.relpath(path, root) if root else path
-    return analyze_source(source, rel.replace(os.sep, "/"), metric_labels)
+    return analyze_source(
+        source, rel.replace(os.sep, "/"), metric_labels, span_stages
+    )
 
 
 def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
@@ -738,17 +794,29 @@ def analyze_paths(
     paths: Sequence[str], root: Optional[str] = None
 ) -> List[Finding]:
     """Analyze every ``.py`` under ``paths``; the metrics-label registry is
-    built from the first ``metrics.py`` encountered in the scanned set."""
+    built from the first ``metrics.py`` encountered in the scanned set, and
+    the span-stage registry from the first ``spans.py``."""
     files = list(_iter_py_files(paths))
     metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None
+    span_stages: Optional[Tuple[str, ...]] = None
     for path in files:
-        if os.path.basename(path) == "metrics.py":
+        base = os.path.basename(path)
+        if base == "metrics.py" and metric_labels is None:
             with open(path, "r", encoding="utf-8") as fh:
                 metric_labels = collect_metric_labels(ast.parse(fh.read()))
+        elif base == "spans.py" and span_stages is None:
+            with open(path, "r", encoding="utf-8") as fh:
+                span_stages = collect_span_stages(ast.parse(fh.read()))
+        if metric_labels is not None and span_stages is not None:
             break
     findings: List[Finding] = []
     for path in files:
-        findings.extend(analyze_file(path, root=root, metric_labels=metric_labels))
+        findings.extend(
+            analyze_file(
+                path, root=root, metric_labels=metric_labels,
+                span_stages=span_stages,
+            )
+        )
     return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 

@@ -277,7 +277,9 @@ class BlockStore:
         with self._lock:
             return self._last_own_block
 
+    @property
     def authority(self) -> AuthorityIndex:
+        """The owning validator's index (immutable; set at open)."""
         return self._authority
 
     # -- dissemination cursors (block_store.rs:220-240,434-476) --

@@ -22,6 +22,7 @@ import time
 from itertools import islice
 from typing import List, Optional, Sequence, Tuple
 
+from . import spans
 from .committee import Committee
 from .tracing import logger
 from .types import StatementBlock, VerificationError
@@ -71,6 +72,12 @@ class SignatureVerifier:
         """Optional: pay one-time costs (tracing, compilation) before the
         first real batch arrives.  Called from a background thread at node
         boot; default no-op."""
+
+    def padded_batch(self, n: int) -> int:
+        """Device lanes an ``n``-signature dispatch actually occupies; the
+        host paths pay no padding.  Telemetry only (padding waste =
+        ``padded_batch(n) - n``)."""
+        return n
 
 
 class CpuSignatureVerifier(SignatureVerifier):
@@ -133,6 +140,15 @@ class TpuSignatureVerifier(SignatureVerifier):
             self.verify_signatures(
                 pks, [dummy] * len(pks), [bytes(64)] * len(pks)
             )
+
+    def padded_batch(self, n: int) -> int:
+        """Lanes dispatched for n signatures under the kernel's fixed bucket
+        shapes (``ops.ed25519.iter_buckets`` is the single source of truth;
+        imported lazily — by the time padding is worth reporting a dispatch
+        has already paid the jax import)."""
+        from .ops.ed25519 import iter_buckets
+
+        return sum(bucket for _, _, bucket in iter_buckets(n))
 
     def verify_signatures(self, public_keys, digests, signatures):
         mesh = self._resolve_mesh()
@@ -212,10 +228,12 @@ class HybridSignatureVerifier(SignatureVerifier):
         tpu: Optional[SignatureVerifier] = None,
         cpu: Optional[SignatureVerifier] = None,
         threshold: Optional[int] = None,
+        metrics=None,
     ) -> None:
         self.tpu = tpu or TpuSignatureVerifier()
         self.cpu = cpu or CpuSignatureVerifier()
         self._fixed_threshold = threshold
+        self.metrics = metrics
         self.cpu_per_sig_s = 0.0
         self.tpu_dispatch_s = 0.0  # fixed component
         self.tpu_per_sig_s = 0.0  # marginal component
@@ -231,6 +249,16 @@ class HybridSignatureVerifier(SignatureVerifier):
     @property
     def backend_label(self) -> str:
         return getattr(self._tls, "label", "hybrid")
+
+    @property
+    def dispatch_padded(self) -> Optional[int]:
+        """Padded lane count of the dispatch that ran in THIS thread (same
+        thread-local lifetime as ``backend_label``).  Recorded at dispatch
+        time because re-deriving the route afterwards can disagree: the
+        dispatch itself updates the EMA cost model, so near the routing
+        crossover ``padded_batch`` would attribute the waste to the wrong
+        route — exactly the drift regime this telemetry exists to debug."""
+        return getattr(self._tls, "padded", None)
 
     def _tpu_time(self, n: int) -> float:
         return self.tpu_dispatch_s + n * self.tpu_per_sig_s
@@ -314,23 +342,42 @@ class HybridSignatureVerifier(SignatureVerifier):
             self.threshold(),
         )
 
+    def _note_route(self, route: str, estimated_s: float, actual_s: float) -> None:
+        """Router decision telemetry: which way the batch went, and how far
+        the cost model's estimate was from the measured dispatch (a drifting
+        estimate is exactly the misroute precursor round 5 debugged blind)."""
+        if self.metrics is None:
+            return
+        self.metrics.verify_route_total.labels(route).inc()
+        if estimated_s > 0.0:
+            self.metrics.verify_route_estimate_error_s.observe(
+                abs(actual_s - estimated_s)
+            )
+
     def verify_signatures(self, public_keys, digests, signatures):
         n = len(signatures)
         if n == 0:
             return []
         if not self._route_to_tpu(n):
+            estimated = n * self.cpu_per_sig_s
             started = time.monotonic()
             out = self.cpu.verify_signatures(public_keys, digests, signatures)
-            sample = (time.monotonic() - started) / n
+            elapsed = time.monotonic() - started
+            sample = elapsed / n
             with self._ema_lock:
                 self.cpu_per_sig_s = _update_ema(
                     self.cpu_per_sig_s, sample, self.EMA_OUTLIER_S
                 )
+            self._note_route("cpu", estimated, elapsed)
             self._tls.label = "hybrid-cpu"
+            self._tls.padded = n  # host oracle: no padding lanes
             return out
+        estimated = self._tpu_time(n)
+        self._tls.padded = self.tpu.padded_batch(n)
         started = time.monotonic()
         out = self.tpu.verify_signatures(public_keys, digests, signatures)
         sample = time.monotonic() - started
+        self._note_route("tpu", estimated, sample)
         with self._ema_lock:
             if sample < self.EMA_OUTLIER_S:
                 # Co-adapt BOTH cost parameters toward the residual each
@@ -678,10 +725,10 @@ class BatchedSignatureVerifier(BlockVerifier):
             sigs = [b.signature for b in sub_blocks]
 
             def _dispatch():
-                # The backend label must be captured in the same thread as
-                # the dispatch: reading it after the await would race with
-                # concurrent flushes that routed the other way (hybrid
-                # cpu/tpu split).
+                # The backend label AND the padded lane count must be
+                # captured in the same thread as the dispatch: reading them
+                # after the await would race with concurrent flushes that
+                # routed the other way (hybrid cpu/tpu split).
                 timer = (
                     self.metrics.utilization_timer("verify:dispatch")
                     if self.metrics is not None
@@ -692,10 +739,16 @@ class BatchedSignatureVerifier(BlockVerifier):
                 label = getattr(
                     self.verifier, "backend_label", type(self.verifier).__name__
                 )
-                return out, label
+                padded = getattr(self.verifier, "dispatch_padded", None)
+                if padded is None:
+                    padder = getattr(self.verifier, "padded_batch", None)
+                    padded = padder(len(sigs)) if padder is not None else len(sigs)
+                return out, label, padded
 
+            tracer = spans.active()
+            t_dispatch = tracer.now() if tracer is not None else 0.0
             started = time.monotonic()
-            out, label = await loop.run_in_executor(None, _dispatch)
+            out, label, padded = await loop.run_in_executor(None, _dispatch)
             # The window EMA shares self._lock with the pending queue: the
             # read-modify-write must not interleave with _effective_delay_s
             # readers scheduling a flush from another flush's critical
@@ -706,9 +759,21 @@ class BatchedSignatureVerifier(BlockVerifier):
                     time.monotonic() - started,
                     self.EMA_OUTLIER_S,
                 )
+            if tracer is not None:
+                for block in sub_blocks:
+                    tracer.record_span(
+                        "verify_dispatch", block.reference, t_dispatch
+                    )
             # Backend counters measure ACTUAL dispatches: counted here, per
             # dispatch, so aggregate-skipped blocks never inflate them.
             if self.metrics is not None:
+                self.metrics.verify_dispatch_batch_size.observe(len(sigs))
+                # Padding waste: lanes the device computed beyond the real
+                # signatures (bucket-shaped dispatches); host backends report
+                # n (zero waste).
+                self.metrics.verify_padding_wasted_total.labels(label).inc(
+                    max(0, padded - len(sigs))
+                )
                 accepted = sum(bool(ok) for ok in out)
                 if accepted:
                     self.metrics.verified_signatures_total.labels(

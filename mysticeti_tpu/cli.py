@@ -71,9 +71,14 @@ async def run_node(
     tps: Optional[int] = None,
 ) -> None:
     """main.rs:159-185."""
+    from . import spans
     from .profiling import start_from_env, stop_from_env
 
     start_from_env()  # MYSTICETI_PROFILE=<path>.folded: lifetime flamegraph
+    # MYSTICETI_TRACE=<path>.json: per-block pipeline spans, exported as
+    # Chrome trace-event JSON (Perfetto-loadable) at shutdown, with periodic
+    # atomic flushes so a SIGKILL'd node still leaves a snapshot.
+    spans.start_from_env()
     # MYSTICETI_CPROFILE=<path> (+ optional MYSTICETI_EXIT_AFTER=<s>): exact
     # deterministic profile of the node's event loop, dumped on clean exit —
     # the sampling profiler can't attribute C-extension time and benchmark
@@ -119,36 +124,43 @@ async def run_node(
                 cprofile_path.replace("%p", str(os.getpid()))
             )
         stop_from_env()
+        spans.stop_from_env()
 
 
 async def testbed(committee_size: int, working_dir: str, duration_s: float,
                   verifier: str = "cpu") -> List:
     """N in-process validators on localhost (main.rs:187-227)."""
-    ips = ["127.0.0.1"] * committee_size
-    benchmark_genesis(ips, working_dir)
-    committee = Committee.load(os.path.join(working_dir, "committee.yaml"))
-    parameters = Parameters.load(os.path.join(working_dir, "parameters.yaml"))
-    signers = Committee.benchmark_signers(committee_size)
-    validators = []
-    for i in range(committee_size):
-        private = PrivateConfig.new_in_dir(
-            i, os.path.join(working_dir, f"validator-{i}")
-        )
-        validators.append(
-            await Validator.start_benchmarking(
-                i,
-                committee,
-                parameters,
-                private,
-                signer=signers[i],
-                serve_metrics_endpoint=False,
-                verifier=verifier,
+    from . import spans
+
+    spans.start_from_env()  # one trace for the whole in-process fleet
+    try:
+        ips = ["127.0.0.1"] * committee_size
+        benchmark_genesis(ips, working_dir)
+        committee = Committee.load(os.path.join(working_dir, "committee.yaml"))
+        parameters = Parameters.load(os.path.join(working_dir, "parameters.yaml"))
+        signers = Committee.benchmark_signers(committee_size)
+        validators = []
+        for i in range(committee_size):
+            private = PrivateConfig.new_in_dir(
+                i, os.path.join(working_dir, f"validator-{i}")
             )
-        )
-    await asyncio.sleep(duration_s)
-    committed = [v.committed_leaders() for v in validators]
-    for v in validators:
-        await v.stop()
+            validators.append(
+                await Validator.start_benchmarking(
+                    i,
+                    committee,
+                    parameters,
+                    private,
+                    signer=signers[i],
+                    serve_metrics_endpoint=False,
+                    verifier=verifier,
+                )
+            )
+        await asyncio.sleep(duration_s)
+        committed = [v.committed_leaders() for v in validators]
+        for v in validators:
+            await v.stop()
+    finally:
+        spans.stop_from_env()
     return committed
 
 
@@ -217,6 +229,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     vs.add_argument("--socket", required=True, help="unix socket path")
     vs.add_argument("--committee-path", default=None,
                     help="prewarm for this committee while validators boot")
+    vs.add_argument("--metrics-port", type=int, default=None,
+                    help="expose /metrics + /healthz (queue depth, "
+                    "in-flight per connection, dispatch sizes, padding)")
 
     f = sub.add_parser(
         "fleet",
@@ -282,7 +297,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         keys = None
         if args.committee_path:
             keys = Committee.load(args.committee_path).public_key_bytes()
-        run_service(args.socket, keys)
+        run_service(args.socket, keys, metrics_port=args.metrics_port)
         return 0
     if args.command == "orchestrator":
         return run_orchestrator(args)

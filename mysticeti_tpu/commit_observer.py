@@ -17,12 +17,28 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
+from . import spans
 from .block_store import BlockStore
 from .committee import Committee, QUORUM, TransactionAggregator
 from .consensus.linearizer import CommittedSubDag, Linearizer
 from .runtime import now as runtime_now
 from .state import CommitObserverRecoveredState
 from .types import BlockReference, StatementBlock
+
+
+def _trace_committed(tracer, t0: float, committed, authority) -> None:
+    """Shared by both observers: one ``finalize`` span per sequenced sub-dag
+    (anchored at its leader) and the close of every sequenced block's
+    ``proposal_wait`` span (opened when the block entered the DAG)."""
+    t1 = tracer.now()
+    for commit in committed:
+        tracer.record_span(
+            "finalize", commit.anchor, t0, t1=t1, authority=authority
+        )
+        for block in commit.blocks:
+            tracer.end_span(
+                "proposal_wait", block.reference, authority=authority, t=t1
+            )
 
 
 class CommitObserver:
@@ -88,6 +104,7 @@ class TestCommitObserver(CommitObserver):
         # (cross-process) and are read with time.time() at the batch-metrics
         # call below.
         now = runtime_now()
+        tracer = spans.active()
         committed = self.commit_interpreter.handle_commit(committed_leaders)
         stamps: List[bytes] = []
         for commit in committed:
@@ -133,6 +150,13 @@ class TestCommitObserver(CommitObserver):
             # Wall clock on purpose: the generator's embedded submission
             # stamps are wall-clock floats shared across processes.
             self._update_metrics_batch(heads, time.time())
+        if tracer is not None:
+            _trace_committed(
+                tracer,
+                now,
+                committed,
+                self.commit_interpreter.block_store.authority,
+            )
         return committed
 
     def _update_metrics_batch(self, heads: bytes, now: float) -> None:
@@ -191,9 +215,13 @@ class SimpleCommitObserver(CommitObserver):
                 )
 
     def handle_commit(self, committed_leaders):
+        tracer = spans.active()
+        t0 = tracer.now() if tracer is not None else 0.0
         committed = self.commit_interpreter.handle_commit(committed_leaders)
         for commit in committed:
             self.sender(commit)
+        if tracer is not None:
+            _trace_committed(tracer, t0, committed, self.block_store.authority)
         return committed
 
     def aggregator_state(self) -> bytes:

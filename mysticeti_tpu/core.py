@@ -25,6 +25,7 @@ import time
 from collections import deque
 from typing import Deque, List, Optional, Sequence, Set, Tuple
 
+from . import spans
 from .block_manager import BlockManager
 from .block_store import (
     BlockStore,
@@ -154,11 +155,23 @@ class Core:
         """Returns first-seen missing references needed to process the input."""
         writer = BlockWriter(self.wal_writer, self.block_store)
         processed, missing_references = self.block_manager.add_blocks(blocks, writer)
+        tracer = spans.active()
+        t_added = tracer.now() if tracer is not None else 0.0
         result = []
         for position, block in sorted(processed, key=lambda pb: pb[1].round()):
             self.threshold_clock.add_block(block.reference, self.committee)
             self.pending.append((position, Include(block.reference)))
             result.append(block)
+            if tracer is not None:
+                tracer.end_span(
+                    "dag_add", block.reference,
+                    authority=self.authority, t=t_added,
+                )
+                # Closed by the commit observer when the block is sequenced.
+                tracer.begin_span(
+                    "proposal_wait", block.reference,
+                    authority=self.authority, t=t_added,
+                )
         self.run_block_handler(result)
         return list(missing_references)
 
@@ -245,6 +258,13 @@ class Core:
             self.metrics.proposed_block_transaction_count.observe(shares)
             self.metrics.proposed_block_vote_count.observe(
                 len(statements) - shares
+            )
+        tracer = spans.active()
+        if tracer is not None:
+            # Own blocks skip receive/verify/dag_add; their pipeline starts
+            # at the wait for commit.
+            tracer.begin_span(
+                "proposal_wait", block.reference, authority=self.authority
             )
         self.threshold_clock.add_block(block.reference, self.committee)
         self.block_handler.handle_proposal(block)

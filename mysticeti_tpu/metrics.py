@@ -242,6 +242,36 @@ class Metrics:
             "verify_batch_size", "signature batch sizes",
             buckets=[1, 8, 32, 64, 128, 256, 512, 1024, 4096],
         )
+        # Verifier hot-path telemetry (the ROADMAP's north-star seam).
+        self.verify_dispatch_batch_size = histogram(
+            "verify_dispatch_batch_size",
+            "signatures per ACTUAL backend dispatch (after aggregation "
+            "skips; verify_batch_size is the collector flush size)",
+            buckets=[1, 8, 32, 64, 128, 256, 512, 1024, 4096],
+        )
+        self.verify_padding_wasted_total = counter(
+            "verify_padding_wasted_total",
+            "padding lanes dispatched (padded bucket size minus actual "
+            "signatures)", labels=("backend",),
+        )
+        self.verify_route_total = counter(
+            "verify_route_total", "hybrid router decisions", labels=("route",)
+        )
+        self.verify_route_estimate_error_s = histogram(
+            "verify_route_estimate_error_s",
+            "|estimated - actual| dispatch time of routed batches",
+            buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                     1.0, 5.0],
+        )
+        self.verifier_service_queue_depth = gauge(
+            "verifier_service_queue_depth",
+            "verify requests queued or dispatching in the verifier service",
+        )
+        self.verifier_service_inflight = gauge(
+            "verifier_service_inflight",
+            "in-flight verify requests per service client connection",
+            labels=("connection",),
+        )
 
         # Utilization timers (metrics.rs:615-666).
         self.utilization_timer_us = counter(
@@ -356,18 +386,32 @@ class MetricReporter:
 
 
 async def serve_metrics(metrics: Metrics, host: str, port: int):
-    """Minimal asyncio HTTP /metrics endpoint (prometheus.rs:31-49)."""
+    """Minimal asyncio HTTP endpoint (prometheus.rs:31-49): ``/metrics`` for
+    the scraper plus ``/healthz`` (200 + uptime) for liveness probes."""
+    started = time.monotonic()
 
     async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
-            await reader.readline()  # request line; drain headers lazily
+            request = await reader.readline()  # e.g. b"GET /healthz HTTP/1.1"
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b"\n", b""):
                     break
-            body = metrics.expose()
+            parts = request.split()
+            path = parts[1].decode(errors="replace") if len(parts) > 1 else "/"
+            if path.split("?", 1)[0] == "/healthz":
+                body = (
+                    '{"status":"ok","uptime_s":%.3f}\n'
+                    % (time.monotonic() - started)
+                ).encode()
+                content_type = b"application/json"
+            else:
+                # Anything else serves the scrape (back-compat: the
+                # orchestrator scraper GETs /metrics).
+                body = metrics.expose()
+                content_type = b"text/plain; version=0.0.4"
             writer.write(
-                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                b"HTTP/1.1 200 OK\r\nContent-Type: " + content_type + b"\r\n"
                 + f"Content-Length: {len(body)}\r\n\r\n".encode()
                 + body
             )

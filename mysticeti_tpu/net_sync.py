@@ -21,6 +21,7 @@ import contextlib
 import threading
 from typing import Dict, List, Optional, Set
 
+from . import spans
 from .block_validator import AcceptAllBlockVerifier, BlockVerifier
 from .commit_observer import CommitObserver
 from .config import Parameters, ROUNDS_IN_EPOCH_MAX
@@ -327,6 +328,8 @@ class NetworkSyncer:
     async def _decode_fresh(self, serialized_blocks) -> List[StatementBlock]:
         """Stage 1 (host, fast): parse, dedup via the core task, consensus-
         rule checks."""
+        tracer = spans.active()
+        t_recv = tracer.now() if tracer is not None else 0.0
         timer = self._utilization_timer
         blocks: List[StatementBlock] = []
         with timer("net:decode"):
@@ -364,6 +367,12 @@ class NetworkSyncer:
                     self.metrics.block_receive_latency.labels(
                         str(block.author())
                     ).observe(max(0.0, now - created / 1e9))
+        if tracer is not None:
+            for block in verified:
+                tracer.record_span(
+                    "receive", block.reference, t_recv,
+                    authority=self.core.authority,
+                )
         return verified
 
     async def _verify_accepted(
@@ -371,8 +380,16 @@ class NetworkSyncer:
     ) -> List[StatementBlock]:
         """Stage 2 (accelerator): signature + application check through the
         pluggable verifier (batched across connections on TPU)."""
+        tracer = spans.active()
+        t_verify = tracer.now() if tracer is not None else 0.0
         results = await self.block_verifier.verify_blocks(verified)
         accepted = [b for b, ok in zip(verified, results) if ok]
+        if tracer is not None:
+            for block in accepted:
+                tracer.record_span(
+                    "verify", block.reference, t_verify,
+                    authority=self.core.authority,
+                )
         if len(accepted) < len(verified):
             log.warning(
                 "block verifier rejected %d of %d blocks",
@@ -383,6 +400,17 @@ class NetworkSyncer:
 
     async def _add_accepted(self, accepted: List[StatementBlock], origin) -> None:
         """Stage 3: hand to the core, chase missing causal history."""
+        tracer = spans.active()
+        if tracer is not None:
+            # Closed by Core.add_blocks when the block is actually inserted,
+            # so the span covers the core-task queue AND any time parked on
+            # missing parents.
+            t = tracer.now()
+            for block in accepted:
+                tracer.begin_span(
+                    "dag_add", block.reference,
+                    authority=self.core.authority, t=t,
+                )
         missing = await self.dispatcher.add_blocks(
             accepted, self.connected_authorities.copy()
         )

@@ -1,0 +1,322 @@
+"""Per-block span tracing: where did a committed block's latency go?
+
+The end-to-end histograms in :mod:`metrics` say *how slow* commits are;
+this module says *which stage* ate the time.  Every block is tracked through
+the pipeline as a sequence of structured spans keyed by
+``(stage, block reference, authority)``:
+
+    receive        net_sync: frame decode + dedup + structure checks
+    verify         net_sync: signature verification (collection window +
+                   dispatch, through the pluggable verifier)
+    verify_dispatch block_validator: one actual accelerator/CPU dispatch
+                   (per block of the dispatched sub-batch)
+    dag_add        net_sync -> core: core-task queue wait + BlockManager
+                   insertion (includes time parked on missing parents)
+    proposal_wait  core -> commit_observer: accepted into the DAG until
+                   sequenced by a committed sub-dag
+    commit         syncer: leader decision + observer + commit persistence
+    finalize       commit_observer: sub-dag linearization + tx accounting
+
+Spans are clocked by the RUNTIME clock (:func:`mysticeti_tpu.runtime.now`):
+virtual under :class:`~mysticeti_tpu.runtime.simulated.DeterministicLoop`
+(so a seeded sim produces a byte-identical trace every run) and monotonic in
+production.  Track identity reuses :data:`tracing.current_authority` as the
+default, with explicit ``authority=`` at sites that know their validator
+index — in a multi-node simulation all nodes share one process and one
+tracer, and the authority keeps their pipelines on separate tracks.
+
+Export is Chrome trace-event JSON, loadable in Perfetto / chrome://tracing:
+set ``MYSTICETI_TRACE=/path/out.json`` (``%p`` expands to the pid, like
+``MYSTICETI_PROFILE``) and the node CLI starts a tracer at boot and writes
+the trace at shutdown.  A daemon thread flushes the file atomically every
+few seconds so a SIGKILL'd benchmark node still leaves a complete snapshot
+(same posture as ``profiling.SamplingProfiler``).  ``tools/trace_report.py``
+prints per-stage latency breakdowns from a trace file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from .runtime import now as runtime_now
+from .tracing import current_authority
+
+# The central stage-name registry.  Instrumentation sites must use literal
+# names from this tuple — the `span-names` lint rule in analysis/checker.py
+# parses this assignment (it must stay a literal tuple of strings) and flags
+# any span call whose stage is not registered.
+STAGES = (
+    "receive",
+    "verify",
+    "verify_dispatch",
+    "dag_add",
+    "proposal_wait",
+    "commit",
+    "finalize",
+)
+
+# The per-block pipeline proper: the stages every committed block crosses.
+# (verify_dispatch is per accelerator dispatch, absent under AcceptAll.)
+PIPELINE_STAGES = (
+    "receive",
+    "verify",
+    "dag_add",
+    "proposal_wait",
+    "commit",
+    "finalize",
+)
+
+ENV_TRACE = "MYSTICETI_TRACE"
+
+# tid for spans recorded with no authority context (e.g. tooling).
+_UNTRACKED_TID = 1 << 20
+
+
+def format_ref(ref) -> str:
+    """Stable human-readable block-reference label for trace args."""
+    return f"A{ref.authority}R{ref.round}#{ref.digest[:4].hex()}"
+
+
+class SpanTracer:
+    """Collects per-block stage spans; exports Chrome trace-event JSON.
+
+    Thread-safe (the periodic flusher reads from a daemon thread while the
+    event loop records), but all recording sites live on the loop thread, so
+    under the deterministic simulator the event sequence — and therefore the
+    exported bytes — is a pure function of the seed.
+    """
+
+    # Hard caps: a long-lived production node must not grow without bound.
+    # proposal_wait spans of blocks that never commit are the main leak;
+    # beyond the cap new records are dropped (counted, never raising).
+    MAX_EVENTS = 1_000_000
+    MAX_OPEN = 200_000
+
+    def __init__(
+        self,
+        flush_path: Optional[str] = None,
+        flush_every_s: float = 5.0,
+    ) -> None:
+        # Completed spans: (stage, ref label, authority, t0, t1).
+        self._events: List[Tuple[str, str, Optional[int], float, float]] = []
+        # Open spans: (stage, ref, authority) -> t0.
+        self._open: Dict[Tuple[str, object, Optional[int]], float] = {}
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.flush_path = flush_path
+        self.flush_every_s = flush_every_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- clock --
+
+    @staticmethod
+    def now() -> float:
+        """The runtime clock: virtual under simulation, monotonic otherwise."""
+        return runtime_now()
+
+    # -- recording --
+
+    def record_span(
+        self,
+        stage: str,
+        ref,
+        t0: float,
+        t1: Optional[float] = None,
+        authority: Optional[int] = None,
+    ) -> None:
+        """Append a completed span measured by the caller."""
+        if authority is None:
+            authority = current_authority.get()
+        if t1 is None:
+            t1 = runtime_now()
+        with self._lock:
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append((stage, format_ref(ref), authority, t0, t1))
+
+    def begin_span(
+        self,
+        stage: str,
+        ref,
+        authority: Optional[int] = None,
+        t: Optional[float] = None,
+    ) -> None:
+        """Open a span; a later :meth:`end_span` with the same key closes it.
+        A key already open keeps its ORIGINAL start (duplicate deliveries
+        must not shrink the measured wait)."""
+        if authority is None:
+            authority = current_authority.get()
+        if t is None:
+            t = runtime_now()
+        key = (stage, ref, authority)
+        with self._lock:
+            if key not in self._open:
+                if len(self._open) >= self.MAX_OPEN:
+                    self.dropped += 1
+                    return
+                self._open[key] = t
+
+    def end_span(
+        self,
+        stage: str,
+        ref,
+        authority: Optional[int] = None,
+        t: Optional[float] = None,
+    ) -> None:
+        """Close an open span; silently ignored when no matching begin was
+        seen (e.g. a block that entered the DAG before tracing started)."""
+        if authority is None:
+            authority = current_authority.get()
+        key = (stage, ref, authority)
+        with self._lock:
+            t0 = self._open.pop(key, None)
+            if t0 is None:
+                return
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            if t is None:
+                t = runtime_now()
+            self._events.append((stage, format_ref(ref), authority, t0, t))
+
+    @contextmanager
+    def span(self, stage: str, ref, authority: Optional[int] = None):
+        t0 = runtime_now()
+        try:
+            yield
+        finally:
+            self.record_span(stage, ref, t0, authority=authority)
+
+    # -- export --
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (Perfetto-loadable).
+
+        One track ("thread") per authority, named ``A<n>``; spans are
+        complete ("X") events with microsecond virtual/monotonic timestamps.
+        Events are globally sorted on a total key so the output is
+        deterministic (and per-track timestamps are monotone by
+        construction).  Only COMPLETED spans are exported.
+        """
+        pid = os.getpid()
+        with self._lock:
+            events = list(self._events)
+
+        def tid_of(authority: Optional[int]) -> int:
+            return _UNTRACKED_TID if authority is None else authority
+
+        tids = {}
+        for _, _, authority, _, _ in events:
+            tid = tid_of(authority)
+            tids[tid] = "untracked" if authority is None else f"A{authority}"
+        trace_events = [
+            {
+                "args": {"name": "mysticeti-tpu"},
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+            }
+        ]
+        for tid in sorted(tids):
+            trace_events.append(
+                {
+                    "args": {"name": tids[tid]},
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                }
+            )
+        spans = [
+            {
+                "args": {"block": label},
+                "cat": "pipeline",
+                "dur": max(0, round((t1 - t0) * 1e6)),
+                "name": stage,
+                "ph": "X",
+                "pid": pid,
+                "tid": tid_of(authority),
+                "ts": round(t0 * 1e6),
+            }
+            for stage, label, authority, t0, t1 in events
+        ]
+        spans.sort(key=lambda e: (e["ts"], e["tid"], e["name"], e["args"]["block"], e["dur"]))
+        trace_events.extend(spans)
+        return {"displayTimeUnit": "ms", "traceEvents": trace_events}
+
+    def write(self, path: str) -> None:
+        """Atomic write (tmp + rename): a SIGKILL landing mid-flush must not
+        replace the previous complete snapshot with a truncated file."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                self.chrome_trace(), f, sort_keys=True, separators=(",", ":")
+            )
+            f.write("\n")
+        os.replace(tmp, path)
+
+    # -- periodic flush (survive SIGKILL, like profiling.SamplingProfiler) --
+
+    def start(self) -> "SpanTracer":
+        if self.flush_path and self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run_flusher, name="mysticeti-tracer", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run_flusher(self) -> None:
+        while not self._stop.wait(self.flush_every_s):
+            try:
+                self.write(self.flush_path)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        """Stop the flusher and write the final complete trace."""
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+        if self.flush_path:
+            self.write(self.flush_path)
+
+
+# ---------------------------------------------------------------------------
+# Process-global tracer (instrumentation sites read it on their hot path)
+
+_active: Optional[SpanTracer] = None
+
+
+def active() -> Optional[SpanTracer]:
+    """The live tracer, or None when tracing is off (the common case: one
+    global read and a None check per instrumentation site)."""
+    return _active
+
+
+def start_from_env() -> Optional[SpanTracer]:
+    """Start trace collection when ``MYSTICETI_TRACE`` is set; the node CLI
+    calls this at boot and :func:`stop_from_env` at shutdown.  ``%p`` in the
+    path expands to the pid so one env var serves a whole local fleet."""
+    global _active
+    path = os.environ.get(ENV_TRACE)
+    if not path or _active is not None:
+        return None
+    path = path.replace("%p", str(os.getpid()))
+    _active = SpanTracer(flush_path=path).start()
+    return _active
+
+
+def stop_from_env() -> None:
+    """Write the final trace and deactivate the global tracer."""
+    global _active
+    if _active is None:
+        return
+    _active.stop()
+    _active = None

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
+from . import spans
 from .commit_observer import CommitObserver
 from .core import Core
 from .tracing import logger
@@ -84,6 +85,8 @@ class Syncer:
             if self.core.epoch_closed():
                 return  # no commits needed once the epoch is safe to close
 
+            tracer = spans.active()
+            t_commit = tracer.now() if tracer is not None else 0.0
             newly_committed = self.core.try_commit()
             if newly_committed:
                 log.debug(
@@ -95,3 +98,11 @@ class Syncer:
             self.core.handle_committed_subdag(
                 committed_subdags, self.commit_observer.aggregator_state()
             )
+            if tracer is not None:
+                # One span per decided leader: decision + observer +
+                # commit/state persistence.
+                for block in newly_committed:
+                    tracer.record_span(
+                        "commit", block.reference, t_commit,
+                        authority=self.core.authority,
+                    )

@@ -40,17 +40,28 @@ _LEVELS = {
 }
 
 
+# Lazy module-level cache: the import must stay deferred (runtime.simulated
+# is only importable once the package is fully initialized), but re-importing
+# on EVERY log record made the formatter's isinstance check pay a sys.modules
+# lookup per line of output.
+_DeterministicLoop = None
+
+
 class SimAwareFormatter(logging.Formatter):
     """``[  12.345s A3] level module: msg`` under a virtual-time loop,
     wall-clock otherwise."""
 
     def format(self, record: logging.LogRecord) -> str:
-        from .runtime.simulated import DeterministicLoop
+        global _DeterministicLoop
+        if _DeterministicLoop is None:
+            from .runtime.simulated import DeterministicLoop
+
+            _DeterministicLoop = DeterministicLoop
 
         stamp = None
         try:
             loop = asyncio.get_running_loop()
-            if isinstance(loop, DeterministicLoop):
+            if isinstance(loop, _DeterministicLoop):
                 stamp = f"{loop.time():9.3f}s"
         except RuntimeError:
             pass
@@ -65,6 +76,11 @@ class SimAwareFormatter(logging.Formatter):
             f"[{stamp}{who}] {record.levelname.lower():<7} {module}: "
             f"{record.getMessage()}"
         )
+
+
+# Child loggers whose level the last applied spec set; reset before the next
+# spec is applied so stale per-module levels never leak across re-installs.
+_touched_modules: set = set()
 
 
 def setup_logging(
@@ -84,6 +100,12 @@ def setup_logging(
         return
     for h in list(root.handlers):
         root.removeHandler(h)
+    # Reset per-module levels a PREVIOUS spec installed: child logger levels
+    # outlive the handler swap, so a force re-install of "warning" after
+    # "net_sync=debug" would otherwise keep net_sync at debug forever.
+    for name in _touched_modules:
+        logging.getLogger(name).setLevel(logging.NOTSET)
+    _touched_modules.clear()
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(SimAwareFormatter())
     root.addHandler(handler)
@@ -95,9 +117,11 @@ def setup_logging(
             continue
         if "=" in token:
             module, _, level = token.partition("=")
-            logging.getLogger(f"{PACKAGE}.{module.strip()}").setLevel(
+            name = f"{PACKAGE}.{module.strip()}"
+            logging.getLogger(name).setLevel(
                 _LEVELS.get(level.strip().lower(), logging.INFO)
             )
+            _touched_modules.add(name)
         else:
             base_level = _LEVELS.get(token.lower(), logging.INFO)
     root.setLevel(base_level)

@@ -106,7 +106,7 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
         backend = (
             tpu_backend
             if kind == "tpu-only"
-            else HybridSignatureVerifier(tpu=tpu_backend)
+            else HybridSignatureVerifier(tpu=tpu_backend, metrics=metrics)
         )
 
         def _warm() -> None:

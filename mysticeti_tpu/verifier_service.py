@@ -45,12 +45,13 @@ cheaper and more accurate.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import os
 import socket
 import struct
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from .block_validator import SignatureVerifier
 from .tracing import logger
@@ -82,12 +83,17 @@ class VerifierServer:
     """One accelerator runtime serving every validator on the host."""
 
     def __init__(self, socket_path: str, committee_keys: Optional[Sequence[bytes]] = None,
-                 backend=None) -> None:
+                 backend=None, metrics=None) -> None:
         self.socket_path = socket_path
         self._backend = backend
         self._keys: Optional[List[bytes]] = (
             list(committee_keys) if committee_keys else None
         )
+        # Optional Metrics: queue depth / per-connection in-flight gauges +
+        # dispatch shape series, scrapeable when the service CLI runs with
+        # --metrics-port (the fleet's verify queue was invisible before).
+        self.metrics = metrics
+        self._conn_ids = itertools.count()
         self._warmed = threading.Event()
         self._warm_lock = threading.Lock()
         # Sized for a 10+ validator fleet: each in-flight request blocks a
@@ -168,6 +174,7 @@ class VerifierServer:
                       writer: asyncio.StreamWriter) -> None:
         loop = asyncio.get_running_loop()
         self._writers.add(writer)
+        conn_label = f"c{next(self._conn_ids)}"
         try:
             while True:
                 try:
@@ -203,9 +210,26 @@ class VerifierServer:
                         writer.write(_frame(T_ERR, b"malformed verify frame"))
                         await writer.drain()
                         return
-                    oks = await loop.run_in_executor(
-                        self._pool, self._verify_payload, type_, n, body
-                    )
+                    metrics = self.metrics
+                    if metrics is not None:
+                        # Depth = requests handed to the pool and not yet
+                        # answered (queued behind the 16 workers or mid-
+                        # dispatch); inflight splits it per client connection
+                        # so one flooding validator is attributable.
+                        metrics.verifier_service_queue_depth.inc()
+                        metrics.verifier_service_inflight.labels(
+                            conn_label
+                        ).inc()
+                    try:
+                        oks = await loop.run_in_executor(
+                            self._pool, self._verify_payload, type_, n, body
+                        )
+                    finally:
+                        if metrics is not None:
+                            metrics.verifier_service_queue_depth.dec()
+                            metrics.verifier_service_inflight.labels(
+                                conn_label
+                            ).dec()
                     writer.write(
                         _frame(T_RESULT, struct.pack("<I", req_id) + bytes(oks))
                     )
@@ -217,6 +241,14 @@ class VerifierServer:
         except (ConnectionResetError, BrokenPipeError, OSError):
             return
         finally:
+            if self.metrics is not None:
+                # Labels are minted per connection from an unbounded counter;
+                # a reconnecting fleet would otherwise grow dead
+                # {connection="cN"} series in the registry forever.
+                try:
+                    self.metrics.verifier_service_inflight.remove(conn_label)
+                except KeyError:
+                    pass  # connection closed before its first verify
             self._writers.discard(writer)
             writer.close()
 
@@ -243,6 +275,15 @@ class VerifierServer:
                 digests.append(body[off + 32: off + 64])
                 sigs.append(body[off + 64: off + 128])
         oks = backend.verify_signatures(pks, digests, sigs)
+        if self.metrics is not None:
+            # The service owns the device, so it (not the jax-free clients)
+            # is where dispatch shape and padding waste are measurable.
+            self.metrics.verify_dispatch_batch_size.observe(n)
+            padder = getattr(backend, "padded_batch", None)
+            if padder is not None:
+                self.metrics.verify_padding_wasted_total.labels(
+                    "service"
+                ).inc(max(0, padder(n) - n))
         return [1 if ok else 0 for ok in oks]
 
     # -- lifecycle --
@@ -426,10 +467,25 @@ class RemoteSignatureVerifier(SignatureVerifier):
         return [bool(b) for b in oks]
 
 
-def run_service(socket_path: str, committee_keys: Optional[Sequence[bytes]] = None) -> None:
-    """Blocking entry point for the CLI subcommand."""
-    server = VerifierServer(socket_path, committee_keys=committee_keys)
+def run_service(socket_path: str, committee_keys: Optional[Sequence[bytes]] = None,
+                metrics_port: Optional[int] = None) -> None:
+    """Blocking entry point for the CLI subcommand.  With ``metrics_port``
+    the service also exposes /metrics + /healthz (queue depth, per-connection
+    in-flight, dispatch batch sizes, padding waste)."""
+
+    async def _main() -> None:
+        metrics = None
+        if metrics_port:
+            from .metrics import Metrics, serve_metrics
+
+            metrics = Metrics()
+            await serve_metrics(metrics, "0.0.0.0", metrics_port)
+        server = VerifierServer(
+            socket_path, committee_keys=committee_keys, metrics=metrics
+        )
+        await server.serve_forever()
+
     try:
-        asyncio.run(server.serve_forever())
+        asyncio.run(_main())
     except KeyboardInterrupt:
         pass

@@ -5,7 +5,10 @@ reporter never cleared, so at fleet load the published p50/p90/p99 described
 the first ~2.5 s of the run forever.  These tests pin the fix: reservoir
 sampling within a window + drain on report.
 """
-from mysticeti_tpu.metrics import Metrics, PreciseHistogram
+import asyncio
+import json
+
+from mysticeti_tpu.metrics import Metrics, PreciseHistogram, serve_metrics
 
 
 def test_percentiles_track_shifted_distribution_after_200k():
@@ -144,3 +147,51 @@ def test_scrape_contains_full_reference_inventory():
     # The precise channels ride histogram_pct{name=...}: check each label.
     for name in sorted(m._precise):
         assert f'name="{name}"' in scrape, name
+    # The verifier hot-path inventory (batch shape, padding, routing,
+    # service queue) — labeled series need one touched child to appear.
+    m.verify_padding_wasted_total.labels("cpu")
+    m.verify_route_total.labels("cpu")
+    m.verifier_service_inflight.labels("c0")
+    scrape = m.expose().decode()
+    for series in (
+        "verify_dispatch_batch_size",
+        "verify_padding_wasted_total",
+        "verify_route_total",
+        "verify_route_estimate_error_s",
+        "verifier_service_queue_depth",
+        "verifier_service_inflight",
+    ):
+        assert series in scrape, series
+
+
+def test_healthz_route_alongside_metrics():
+    """The metrics endpoint answers /healthz with 200 + uptime and keeps
+    serving the prometheus scrape on /metrics."""
+
+    async def scenario():
+        metrics = Metrics()
+        server = await serve_metrics(metrics, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+
+        async def get(path):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+            await writer.drain()
+            data = await reader.read()
+            writer.close()
+            return data
+
+        health = await get("/healthz")
+        scrape = await get("/metrics")
+        server.close()
+        await server.wait_closed()
+        return health, scrape
+
+    health, scrape = asyncio.run(scenario())
+    head, _, body = health.partition(b"\r\n\r\n")
+    assert b"200 OK" in head and b"application/json" in head
+    payload = json.loads(body)
+    assert payload["status"] == "ok"
+    assert payload["uptime_s"] >= 0.0
+    assert b"200 OK" in scrape
+    assert b"benchmark_duration" in scrape

@@ -1,0 +1,62 @@
+"""Flamegraph rendering CLI path: folded file -> SVG round-trip through
+``profiling.render_file`` and ``tools/mkflamegraph.py``, including the
+empty-profile edge case (a node killed before the first flush)."""
+import os
+import subprocess
+import sys
+
+from mysticeti_tpu.profiling import flamegraph_svg, render_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MKFLAMEGRAPH = os.path.join(REPO, "tools", "mkflamegraph.py")
+
+FOLDED = "main:run;core:add_blocks;crypto:verify 42\nmain:run;net:decode 13\n"
+
+
+def test_render_file_roundtrip_default_output(tmp_path):
+    folded = tmp_path / "node.folded"
+    folded.write_text(FOLDED)
+    out = render_file(str(folded))
+    assert out == str(tmp_path / "node.svg")
+    svg = open(out).read()
+    assert svg.startswith("<svg")
+    assert "crypto:verify" in svg and "net:decode" in svg
+    # Tooltips carry sample counts + percentages (flamegraph.pl parity).
+    assert "42 samples" in svg
+
+
+def test_render_file_explicit_output_and_empty_profile(tmp_path):
+    empty = tmp_path / "empty.folded"
+    empty.write_text("")
+    out = render_file(str(empty), str(tmp_path / "custom.svg"))
+    assert out == str(tmp_path / "custom.svg")
+    svg = open(out).read()
+    # No division-by-zero; a valid (if trivial) SVG is still produced.
+    assert svg.startswith("<svg") and svg.rstrip().endswith("</svg>")
+
+
+def test_flamegraph_svg_ignores_malformed_lines():
+    svg = flamegraph_svg(["not-a-folded-line", "a;b 3", ""])
+    assert "a" in svg and "3 samples" in svg
+
+
+def test_mkflamegraph_cli_roundtrip(tmp_path):
+    folded = tmp_path / "node.folded"
+    folded.write_text(FOLDED)
+    out = tmp_path / "flame.svg"
+    proc = subprocess.run(
+        [sys.executable, MKFLAMEGRAPH, str(folded), str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == str(out)
+    assert open(out).read().startswith("<svg")
+
+
+def test_mkflamegraph_cli_usage_error():
+    proc = subprocess.run(
+        [sys.executable, MKFLAMEGRAPH],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "Usage" in proc.stderr or "usage" in proc.stderr

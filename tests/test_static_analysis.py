@@ -320,6 +320,63 @@ def test_metrics_labels_negative():
     assert findings == []
 
 
+# -- rule 7: span-names -------------------------------------------------------
+
+_SPAN_STAGES = ("receive", "verify", "commit")
+
+
+def test_span_names_positive_typo():
+    findings = run(
+        """
+        def trace(tracer, ref):
+            tracer.record_span("recieve", ref, 0.0)
+            tracer.begin_span("comit", ref)
+        """,
+        span_stages=_SPAN_STAGES,
+    )
+    assert rules_of(findings) == ["span-names"]
+    assert len(findings) == 2
+    assert "recieve" in findings[0].message
+
+
+def test_span_names_negative():
+    findings = run(
+        """
+        def trace(tracer, ref, stage):
+            tracer.record_span("receive", ref, 0.0)
+            tracer.begin_span("verify", ref)
+            tracer.end_span("commit", ref)
+            tracer.end_span(stage, ref)      # computed stage: skipped
+            writer.flush("recieve")          # not a span call
+        """,
+        span_stages=_SPAN_STAGES,
+    )
+    assert findings == []
+
+
+def test_span_names_skipped_without_registry():
+    findings = run(
+        """
+        def trace(tracer, ref):
+            tracer.record_span("anything-goes", ref, 0.0)
+        """
+    )
+    assert findings == []
+
+
+def test_span_registry_parsed_from_spans_py():
+    """analyze_paths picks the registry up from the real spans.py; it must
+    stay a literal tuple so the parse keeps working."""
+    import ast
+
+    from mysticeti_tpu.analysis.checker import collect_span_stages
+    from mysticeti_tpu.spans import STAGES
+
+    with open(os.path.join(PKG, "spans.py")) as fh:
+        parsed = collect_span_stages(ast.parse(fh.read()))
+    assert parsed == STAGES
+
+
 # -- suppressions and baseline ------------------------------------------------
 
 def test_inline_suppression_matches_rule():

@@ -41,6 +41,39 @@ def test_unset_spec_is_noop():
     assert not root.handlers
 
 
+def test_force_reinstall_resets_stale_module_levels():
+    """A force re-install must not inherit per-module levels from the
+    previous spec: after switching 'net_sync=debug,warning' -> 'warning',
+    net_sync debug records stay hidden."""
+    _fresh_root()
+    first = io.StringIO()
+    setup_logging("net_sync=debug,warning", stream=first, force=True)
+    assert logging.getLogger(f"{PACKAGE}.net_sync").level == logging.DEBUG
+    second = io.StringIO()
+    setup_logging("warning", stream=second, force=True)
+    assert logging.getLogger(f"{PACKAGE}.net_sync").level == logging.NOTSET
+    logger(f"{PACKAGE}.net_sync").debug("stale debug hidden")
+    logger(f"{PACKAGE}.net_sync").warning("warn visible")
+    out = second.getvalue()
+    assert "stale debug hidden" not in out
+    assert "warn visible" in out
+    _fresh_root()
+
+
+def test_formatter_caches_loop_class_module_level():
+    """The DeterministicLoop import is resolved once and cached at module
+    level, not re-imported per log record."""
+    import mysticeti_tpu.tracing as tracing_mod
+    from mysticeti_tpu.runtime.simulated import DeterministicLoop
+
+    record = logging.LogRecord(
+        f"{PACKAGE}.core", logging.INFO, __file__, 1, "hello", (), None
+    )
+    out = SimAwareFormatter().format(record)
+    assert "core: hello" in out
+    assert tracing_mod._DeterministicLoop is DeterministicLoop
+
+
 def test_virtual_time_and_authority_prefix():
     _fresh_root()
     stream = io.StringIO()

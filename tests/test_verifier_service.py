@@ -195,6 +195,48 @@ def test_concurrent_clients_share_one_backend(tmp_path, signers):
     asyncio.run(_with_server(tmp_path, keys, backend, scenario))
 
 
+def test_service_telemetry_queue_and_inflight_gauges(tmp_path, signers):
+    """With metrics wired, the service tracks queue depth and per-connection
+    in-flight requests (back to zero once answered) plus dispatch shape and
+    padding series."""
+    from mysticeti_tpu.metrics import Metrics
+
+    keys = [s.public_key.bytes for s in signers]
+    backend = CountingBackend()
+    metrics = Metrics()
+
+    async def scenario():
+        server = VerifierServer(
+            str(tmp_path / "verifier.sock"),
+            committee_keys=keys,
+            backend=backend,
+            metrics=metrics,
+        )
+        await server.start()
+        try:
+            client = RemoteSignatureVerifier(
+                socket_path=server.socket_path, committee_keys=keys
+            )
+            pks, digests, sigs = _sigs(8, signers)
+            oks = await asyncio.to_thread(
+                client.verify_signatures, pks, digests, sigs
+            )
+            assert all(oks)
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+    assert metrics.verifier_service_queue_depth._value.get() == 0
+    scrape = metrics.expose().decode()
+    # The per-connection in-flight child is REMOVED once the connection
+    # closes: reconnecting fleets must not grow dead labeled series forever.
+    assert 'verifier_service_inflight{connection="c0"}' not in scrape
+    # One 8-signature dispatch was observed, with zero padding on the
+    # CPU-backed test backend.
+    assert "verify_dispatch_batch_size_count 1.0" in scrape
+    assert 'verify_padding_wasted_total{backend="service"} 0.0' in scrape
+
+
 def test_make_verifier_uses_service_when_env_set(tmp_path, signers, monkeypatch):
     """validator.py:_make_verifier routes tpu kinds through the service —
     and the validator side never builds its own JAX backend."""

@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Per-stage latency breakdown from a MYSTICETI_TRACE Chrome trace file.
+
+Usage:
+    python tools/trace_report.py trace.json [--by-track]
+
+Reads the trace-event JSON written by ``mysticeti_tpu.spans`` (set
+``MYSTICETI_TRACE=/path/out.json`` on a node or testbed run, or load the
+same file in Perfetto for the visual timeline) and prints count / p50 / p90 /
+p99 / max duration per pipeline stage — the "which stage ate the commit
+latency" table.  ``--by-track`` splits the breakdown per authority track.
+"""
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mysticeti_tpu.spans import STAGES  # noqa: E402
+
+
+def load_events(path: str) -> List[dict]:
+    """All events from a Chrome trace-event JSON file (parsed once — a
+    MAX_EVENTS-capped production trace is hundreds of MB)."""
+    with open(path) as f:
+        data = json.load(f)
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def load_spans(events: List[dict]) -> List[dict]:
+    """Complete ("X") span events."""
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _track_names(events: List[dict]) -> Dict[Tuple[int, int], str]:
+    return {
+        (e.get("pid", 0), e.get("tid", 0)): e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+
+
+def _pct(ordered: List[float], pct: float) -> float:
+    idx = min(len(ordered) - 1, int(len(ordered) * pct / 100))
+    return ordered[idx]
+
+
+def _stage_order(name: str) -> Tuple[int, str]:
+    try:
+        return (STAGES.index(name), name)
+    except ValueError:
+        return (len(STAGES), name)
+
+
+def build_report(spans: List[dict], by_track: bool = False,
+                 track_names: Dict[Tuple[int, int], str] = {}) -> str:
+    groups: Dict[Tuple, List[float]] = defaultdict(list)
+    for e in spans:
+        key = (e["name"],)
+        if by_track:
+            pid_tid = (e.get("pid", 0), e.get("tid", 0))
+            key = (e["name"], track_names.get(pid_tid, str(pid_tid[1])))
+        groups[key].append(e.get("dur", 0) / 1e3)  # µs -> ms
+    if not groups:
+        return "no spans in trace"
+    header = f"{'stage':<16}" + (f"{'track':<10}" if by_track else "") + (
+        f"{'count':>8}{'p50_ms':>10}{'p90_ms':>10}{'p99_ms':>10}{'max_ms':>10}"
+    )
+    lines = [header]
+    for key in sorted(groups, key=lambda k: (_stage_order(k[0]),) + k[1:]):
+        durs = sorted(groups[key])
+        row = f"{key[0]:<16}"
+        if by_track:
+            row += f"{key[1]:<10}"
+        row += (
+            f"{len(durs):>8}"
+            f"{_pct(durs, 50):>10.3f}"
+            f"{_pct(durs, 90):>10.3f}"
+            f"{_pct(durs, 99):>10.3f}"
+            f"{durs[-1]:>10.3f}"
+        )
+        lines.append(row)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace_report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("trace", help="Chrome trace-event JSON (MYSTICETI_TRACE output)")
+    parser.add_argument(
+        "--by-track", action="store_true",
+        help="split the breakdown per authority track",
+    )
+    args = parser.parse_args(argv)
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"error: cannot read trace {args.trace}: {exc}", file=sys.stderr)
+        return 2
+    names = _track_names(events) if args.by_track else {}
+    print(build_report(load_spans(events), by_track=args.by_track,
+                       track_names=names))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
