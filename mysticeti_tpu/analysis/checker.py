@@ -74,6 +74,11 @@ GUARDED_FIELDS: Dict[str, str] = {
     "cpu_per_sig_s": "_ema_lock",
     "tpu_dispatch_s": "_ema_lock",
     "tpu_per_sig_s": "_ema_lock",
+    # Hybrid verifier circuit breaker: tripped/probed/closed from concurrent
+    # dispatch threads; shares the EMA lock (same writers, same cadence).
+    "_breaker_backoff_s": "_ema_lock",
+    "_breaker_open_until": "_ema_lock",
+    "_breaker_probing": "_ema_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

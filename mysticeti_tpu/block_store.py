@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .serde import Reader, Writer
+from .tracing import logger
 from .types import (
     AuthorityIndex,
     BlockReference,
@@ -34,7 +35,9 @@ from .types import (
     StatementBlock,
     TransactionLocator,
 )
-from .wal import POSITION_MAX, Tag, WalPosition, WalReader, WalWriter
+from .wal import HEADER_SIZE, POSITION_MAX, Tag, WalPosition, WalReader, WalWriter
+
+log = logger(__name__)
 
 WAL_ENTRY_BLOCK: Tag = 1
 WAL_ENTRY_PAYLOAD: Tag = 2
@@ -135,7 +138,9 @@ class BlockStore:
 
         store = cls(authority, len(committee), wal_reader, metrics)
         builder = RecoveredStateBuilder()
+        replayed_end: WalPosition = 0
         for pos, tag, payload in wal_reader.iter_until(wal_writer.position()):
+            replayed_end = pos + HEADER_SIZE + len(payload)
             if tag == WAL_ENTRY_BLOCK:
                 block = StatementBlock.from_bytes(payload)
                 builder.block(pos, block)
@@ -158,36 +163,87 @@ class BlockStore:
                 continue
             else:
                 raise ValueError(f"unknown wal tag {tag} at position {pos}")
-            store._add_unloaded(block.reference, pos)
+            store._add_unloaded(
+                block.reference, pos, proposed=tag == WAL_ENTRY_OWN_BLOCK
+            )
+        if replayed_end < wal_writer.position():
+            # Torn tail (crash mid-write): replay stopped at the tear.  The
+            # torn bytes must be truncated away before the first new append —
+            # writing past them would leave an unreplayable gap that silently
+            # loses every subsequent entry on the NEXT recovery.
+            log.warning(
+                "torn WAL tail: replay stopped at %d, discarding %d trailing "
+                "bytes", replayed_end, wal_writer.position() - replayed_end,
+            )
+            wal_writer.truncate_to(replayed_end)
+            wal_reader.cleanup()  # drop any mapping that covers the old size
         return builder.build(store)
 
     # -- writes --
 
-    def insert_block(self, block: StatementBlock, position: WalPosition) -> None:
+    def insert_block(
+        self, block: StatementBlock, position: WalPosition,
+        proposed: bool = False,
+    ) -> None:
         with self._lock:
             self._highest_round = max(self._highest_round, block.round())
-            self._add_own_index(block.reference)
+            self._add_own_index(block.reference, proposed)
             self._update_last_seen(block.reference)
             self._index.setdefault(block.round(), {})[
                 (block.author(), block.digest())
             ] = (position, block)
 
-    def _add_unloaded(self, reference: BlockReference, position: WalPosition) -> None:
+    def _add_unloaded(
+        self, reference: BlockReference, position: WalPosition,
+        proposed: bool = False,
+    ) -> None:
         self._highest_round = max(self._highest_round, reference.round)
-        self._add_own_index(reference)
+        self._add_own_index(reference, proposed)
         self._update_last_seen(reference)
         self._index.setdefault(reference.round, {})[
             (reference.authority, reference.digest)
         ] = (position, None)
 
-    def _add_own_index(self, reference: BlockReference) -> None:
+    def _add_own_index(
+        self, reference: BlockReference, proposed: bool = False
+    ) -> None:
+        """``proposed`` marks OUR proposal write path (``insert_own_block``
+        and OWN_BLOCK replay) as opposed to a peer-delivered or fetched copy
+        of an own-authority block."""
         if reference.authority != self._authority:
             return
         last = self._last_own_block.round if self._last_own_block else 0
         if reference.round > last:
             self._last_own_block = reference
-        if reference.round in self._own_blocks:
-            raise ValueError(f"duplicate own block for round {reference.round}")
+        prev = self._own_blocks.get(reference.round)
+        if prev is not None:
+            if prev != reference.digest:
+                # Post-crash equivocation: with fsync=false a torn WAL tail
+                # can lose our own last proposal; after restart we re-propose
+                # that round and may ALSO receive the lost block back from
+                # peers (it sits in their causal histories).  The block we
+                # actually PROPOSED must win the dissemination index — our
+                # subsequent blocks build on it, and serving the stale copy
+                # from get_own_blocks would push every post-restart proposal
+                # through the slow missing-parent path.  Either way this is
+                # a warning, never a raise: consensus tolerates the
+                # equivocation like any other Byzantine double-proposal,
+                # whereas crashing here would turn a recovered node into a
+                # crash loop.
+                if proposed:
+                    self._own_blocks[reference.round] = reference.digest
+                    if (
+                        self._last_own_block is not None
+                        and self._last_own_block.round == reference.round
+                    ):
+                        self._last_own_block = reference
+                log.warning(
+                    "own-block conflict at round %d (pre-crash proposal lost "
+                    "to a torn WAL?); keeping the %s digest",
+                    reference.round,
+                    "re-proposed" if proposed else "first-indexed",
+                )
+            return
         self._own_blocks[reference.round] = reference.digest
 
     def _update_last_seen(self, reference: BlockReference) -> None:
@@ -353,6 +409,13 @@ class BlockStore:
             self._metrics.block_store_unloaded_blocks.inc(unloaded)
         return unloaded
 
+    def close(self) -> None:
+        """Release the WAL reader (mmap + fd).  Crash-restart simulation
+        reopens the same path many times in one process; without this every
+        restart would leak a descriptor and a mapping for the sim's whole
+        lifetime."""
+        self._wal_reader.close()
+
 
 class BlockWriter:
     """Write-through of blocks to WAL + index (block_store.rs:504-518).
@@ -374,5 +437,5 @@ class BlockWriter:
 
     def insert_own_block(self, data: OwnBlockData) -> WalPosition:
         pos = data.write_to_wal(self.wal_writer)
-        self.block_store.insert_block(data.block, pos)
+        self.block_store.insert_block(data.block, pos, proposed=True)
         return pos

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import random
 import threading
 import time
 from itertools import islice
@@ -24,11 +25,21 @@ from typing import List, Optional, Sequence, Tuple
 
 from . import spans
 from .committee import Committee
+from .network import jittered_backoff
 from .tracing import logger
 from .types import StatementBlock, VerificationError
 from .utils.tasks import spawn_logged
 
 log = logger(__name__)
+
+
+class VerifierProtocolError(ConnectionError):
+    """A verifier backend answered but REJECTED the request (committee
+    mismatch, malformed frame).  Retrying cannot help and the circuit
+    breaker must NOT treat it as an outage: a misconfigured validator fails
+    fast instead of silently serving on the CPU oracle forever.  Defined
+    here (not in verifier_service.py) so the breaker can exclude it without
+    a circular import; the service module re-exports it."""
 
 
 class BlockVerifier:
@@ -222,6 +233,15 @@ class HybridSignatureVerifier(SignatureVerifier):
     # degraded jax-CPU backend (seconds per dispatch) must not.
     MAX_OFFLOAD_LATENCY_S = 0.5
     EMA_OUTLIER_S = 5.0  # ignore one-time compile stalls
+    # Circuit breaker over the accelerator route: a dead backend (verifier
+    # service restart, tunnel outage) degrades to the CPU oracle instead of
+    # crashing the dispatch thread; re-probes use jittered exponential
+    # backoff so a fleet that lost ONE shared service never re-probes it in
+    # lockstep.  Only transport/timeout failures trip it — a
+    # VerificationError-shaped rejection is a verdict, not an outage.
+    BREAKER_EXCEPTIONS = (ConnectionError, TimeoutError, OSError)
+    BREAKER_BASE_BACKOFF_S = 1.0
+    BREAKER_MAX_BACKOFF_S = 30.0
 
     def __init__(
         self,
@@ -239,6 +259,17 @@ class HybridSignatureVerifier(SignatureVerifier):
         self.tpu_per_sig_s = 0.0  # marginal component
         # EMA read-modify-writes happen from executor threads; serialize them.
         self._ema_lock = threading.Lock()
+        # Breaker state shares _ema_lock (same writer threads, same cadence).
+        # backoff == 0.0 means closed; while open, dispatches fall back to
+        # the CPU oracle until the probe deadline passes.  _breaker_probing
+        # keeps the probe EXCLUSIVE even when it outlives the backoff
+        # interval (a hung service blocks the probe thread for the whole
+        # dispatch timeout; new windows must not admit more victims).
+        self._breaker_backoff_s = 0.0
+        self._breaker_open_until = 0.0
+        self._breaker_probing = False
+        self._breaker_rng = random.Random(0x0B7EA6E5)
+        self._breaker_clock = time.monotonic  # injectable for tests
         # Routing label of the dispatch that ran in THIS thread: the batching
         # collector reads it right after verify_signatures returns, in the
         # same executor thread, so thread-local storage is exactly the
@@ -303,10 +334,63 @@ class HybridSignatureVerifier(SignatureVerifier):
             best = min(best, n_budget)
         return best
 
+    # -- circuit breaker --
+
+    @property
+    def breaker_open(self) -> bool:
+        return self._breaker_backoff_s > 0.0
+
+    def _breaker_blocks(self) -> bool:
+        """True while the breaker holds the accelerator route closed.  Once
+        the probe deadline passes, exactly ONE dispatch gets through as the
+        probe — the ``_breaker_probing`` flag (not a pushed deadline) keeps
+        it exclusive even when the probe outlives the backoff interval."""
+        with self._ema_lock:
+            if self._breaker_backoff_s == 0.0:
+                return False
+            now = self._breaker_clock()
+            if self._breaker_probing or now < self._breaker_open_until:
+                return True
+            self._breaker_probing = True
+            return False
+
+    def _trip_breaker(self, exc: BaseException) -> None:
+        now = self._breaker_clock()
+        with self._ema_lock:
+            self._breaker_probing = False
+            prev = self._breaker_backoff_s
+            backoff = (
+                self.BREAKER_BASE_BACKOFF_S
+                if prev == 0.0
+                else min(prev * 2.0, self.BREAKER_MAX_BACKOFF_S)
+            )
+            self._breaker_backoff_s = backoff
+            self._breaker_open_until = now + jittered_backoff(
+                backoff, self._breaker_rng
+            )
+        log.warning(
+            "accelerator verify path failed (%r): circuit open, degrading to "
+            "the CPU oracle; next probe in ~%.1f s", exc, backoff,
+        )
+
+    def _close_breaker(self) -> None:
+        with self._ema_lock:
+            was_open = self._breaker_backoff_s > 0.0
+            self._breaker_backoff_s = 0.0
+            self._breaker_probing = False
+        if was_open:
+            log.info("accelerator verify path recovered: circuit closed")
+
+    def _clear_probe(self) -> None:
+        """Release probe exclusivity when the dispatch neither succeeded nor
+        counted as an outage (a propagating non-breaker exception) — a stuck
+        flag would otherwise hold the breaker open forever."""
+        with self._ema_lock:
+            self._breaker_probing = False
+
     def warmup(self) -> None:
         from . import crypto
 
-        self.tpu.warmup()  # trace/compile (or persistent-cache load)
         signer = crypto.Signer.dummy()
         digest = crypto.blake2b_256(b"hybrid-warmup")
         sig = signer.sign(digest)
@@ -316,12 +400,21 @@ class HybridSignatureVerifier(SignatureVerifier):
         # with every client over HELLO_OK) — N co-located validators each
         # probing a shared service would serialize N dispatches behind boot
         # contention.  A local backend without one gets the probe dispatch.
-        calibrate = getattr(self.tpu, "dispatch_calibration", None)
-        provided = calibrate() if calibrate is not None else None
-        if provided is None:
-            started = time.monotonic()
-            self.tpu.verify_signatures([pk], [digest], [sig])
-            provided = (time.monotonic() - started, 0.0)
+        # An unreachable backend (service not yet up, tunnel down) must not
+        # kill the warmup thread: trip the breaker and boot on the oracle.
+        provided = None
+        try:
+            self.tpu.warmup()  # trace/compile (or persistent-cache load)
+            calibrate = getattr(self.tpu, "dispatch_calibration", None)
+            provided = calibrate() if calibrate is not None else None
+            if provided is None:
+                started = time.monotonic()
+                self.tpu.verify_signatures([pk], [digest], [sig])
+                provided = (time.monotonic() - started, 0.0)
+        except self.BREAKER_EXCEPTIONS as exc:
+            if isinstance(exc, VerifierProtocolError):
+                raise  # misconfiguration, not an outage: fail fast
+            self._trip_breaker(exc)
         started = time.monotonic()
         reps = 32
         self.cpu.verify_signatures([pk] * reps, [digest] * reps, [sig] * reps)
@@ -331,7 +424,8 @@ class HybridSignatureVerifier(SignatureVerifier):
         # calibration writes must join the same lock or a concurrent RMW
         # that read the pre-warmup value could land after and discard them.
         with self._ema_lock:
-            self.tpu_dispatch_s, self.tpu_per_sig_s = provided
+            if provided is not None:
+                self.tpu_dispatch_s, self.tpu_per_sig_s = provided
             self.cpu_per_sig_s = cpu_probe
         log.info(
             "hybrid verifier calibrated: tpu %.1f ms fixed + %.1f µs/sig, "
@@ -358,25 +452,58 @@ class HybridSignatureVerifier(SignatureVerifier):
         n = len(signatures)
         if n == 0:
             return []
-        if not self._route_to_tpu(n):
-            estimated = n * self.cpu_per_sig_s
-            started = time.monotonic()
-            out = self.cpu.verify_signatures(public_keys, digests, signatures)
-            elapsed = time.monotonic() - started
-            sample = elapsed / n
-            with self._ema_lock:
-                self.cpu_per_sig_s = _update_ema(
-                    self.cpu_per_sig_s, sample, self.EMA_OUTLIER_S
-                )
-            self._note_route("cpu", estimated, elapsed)
-            self._tls.label = "hybrid-cpu"
-            self._tls.padded = n  # host oracle: no padding lanes
-            return out
+        degraded = False
+        if self._route_to_tpu(n):
+            if self._breaker_blocks():
+                degraded = True  # circuit open: the route is held closed
+            else:
+                try:
+                    return self._verify_tpu(
+                        public_keys, digests, signatures, n
+                    )
+                except self.BREAKER_EXCEPTIONS as exc:
+                    if isinstance(exc, VerifierProtocolError):
+                        # A rejection (committee mismatch, malformed frame)
+                        # is a configuration bug, not an outage: fail fast.
+                        self._clear_probe()
+                        raise
+                    # Outage, not a verdict: trip the breaker and verify
+                    # THIS batch on the oracle — the dispatch thread (and
+                    # with it the whole batching collector) must survive a
+                    # dead accelerator.
+                    self._trip_breaker(exc)
+                    degraded = True
+                except BaseException:
+                    self._clear_probe()
+                    raise
+        if degraded and self.metrics is not None:
+            # One count per DEGRADED BATCH (matching the series help text),
+            # not per breaker trip.
+            self.metrics.verifier_fallback_total.inc()
+        return self._verify_cpu(public_keys, digests, signatures, n)
+
+    def _verify_cpu(self, public_keys, digests, signatures, n):
+        estimated = n * self.cpu_per_sig_s
+        started = time.monotonic()
+        out = self.cpu.verify_signatures(public_keys, digests, signatures)
+        elapsed = time.monotonic() - started
+        sample = elapsed / n
+        with self._ema_lock:
+            self.cpu_per_sig_s = _update_ema(
+                self.cpu_per_sig_s, sample, self.EMA_OUTLIER_S
+            )
+        self._note_route("cpu", estimated, elapsed)
+        self._tls.label = "hybrid-cpu"
+        self._tls.padded = n  # host oracle: no padding lanes
+        return out
+
+    def _verify_tpu(self, public_keys, digests, signatures, n):
         estimated = self._tpu_time(n)
         self._tls.padded = self.tpu.padded_batch(n)
         started = time.monotonic()
         out = self.tpu.verify_signatures(public_keys, digests, signatures)
         sample = time.monotonic() - started
+        self._close_breaker()  # a successful probe re-opens the route
         self._note_route("tpu", estimated, sample)
         with self._ema_lock:
             if sample < self.EMA_OUTLIER_S:

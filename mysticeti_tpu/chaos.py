@@ -1,0 +1,737 @@
+"""Deterministic chaos engine: seeded fault injection over the virtual-time sim.
+
+The reproduction's headline claim (arXiv 2310.14821) is that commits stay
+safe and low-latency under crash faults and partitions — but the sim tier
+only exercised static partition/heal, and the WAL recovery path
+(``core.py``/``validator.init_storage``) was never driven mid-simulation.
+This module closes that gap with four pieces:
+
+* :class:`FaultPlan` — a declarative, JSON-serializable plan: per-link
+  message drop/duplicate/delay probabilities (:class:`LinkFault`), timed
+  (a)symmetric partitions (:class:`PartitionFault`), and crash-restarts of
+  whole validators (:class:`CrashFault`), optionally with a torn WAL tail.
+* :class:`ChaosEngine` — executes a plan against a fleet on the
+  :class:`~mysticeti_tpu.runtime.simulated.DeterministicLoop`.  The timed
+  schedule is resolved up-front from the plan alone (:func:`resolve_schedule`)
+  and per-message coin flips come from a dedicated ``random.Random`` seeded by
+  the plan, so a same-seed re-run produces a byte-identical fault schedule
+  AND byte-identical fault log (:meth:`ChaosEngine.fault_log_bytes`).
+* :class:`SafetyChecker` — cross-node, cross-restart commit auditor: every
+  committed sub-dag is recorded by (authority, height); two anchors at the
+  same height — on different nodes, or on one node before and after a
+  crash — raise :class:`SafetyViolation` the moment they are observed.
+* :class:`ChaosSimHarness` — an N-validator fleet over
+  :class:`~mysticeti_tpu.simulated_network.SimulatedNetwork` whose per-node
+  WALs survive crash-restart: :meth:`ChaosSimHarness.restart` rebuilds the
+  validator from the SAME WAL path, driving the full
+  ``BlockStore.open`` -> ``Core`` recovery path under fire.
+
+Partitions are injected as directed BLACKHOLES (messages dropped while the
+connections stay up) rather than severed links: that is the nastier fault —
+no closure event tells either side anything happened — and it composes
+cleanly with concurrent crashes and asymmetric (one-way) cuts.  The severed
+flavor remains available directly on ``SimulatedNetwork.partition``.
+
+``mysticeti-tpu chaos --plan plan.json`` replays a plan from JSON (cli.py);
+``docs/fault-injection.md`` documents the schema and guarantees.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .block_handler import TestBlockHandler
+from .block_store import BlockStore
+from .commit_observer import TestCommitObserver
+from .committee import Committee
+from .config import Parameters
+from .core import Core, CoreOptions
+from .metrics import Metrics
+from .net_sync import NetworkSyncer
+from .simulated_network import SimulatedNetwork
+from .tracing import logger
+from .types import BlockReference
+from .utils.tasks import spawn_logged
+from .wal import walf
+
+log = logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Fault plan (declarative, JSON round-trippable)
+
+
+@dataclass(frozen=True)
+class LinkFault:
+    """Probabilistic per-message faults on matching links inside a window.
+
+    ``src``/``dst`` of ``None`` match any sender/receiver; ``end_s`` of
+    ``None`` means "until the end of the run".  The first matching fault in
+    plan order wins for a given (src, dst, t).  ``duplicate_p`` re-delivers a
+    copy after an extra ``delay_extra_s`` draw — duplicates are always late
+    (an on-time duplicate is indistinguishable from the original in-order
+    delivery and would test nothing).
+    """
+
+    drop_p: float = 0.0
+    duplicate_p: float = 0.0
+    delay_p: float = 0.0
+    delay_extra_s: Tuple[float, float] = (0.05, 0.25)
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    start_s: float = 0.0
+    end_s: Optional[float] = None
+
+    def matches(self, src: int, dst: int, t: float) -> bool:
+        if self.src is not None and self.src != src:
+            return False
+        if self.dst is not None and self.dst != dst:
+            return False
+        if t < self.start_s:
+            return False
+        return self.end_s is None or t < self.end_s
+
+    def to_dict(self) -> dict:
+        return {
+            "drop_p": self.drop_p,
+            "duplicate_p": self.duplicate_p,
+            "delay_p": self.delay_p,
+            "delay_extra_s": list(self.delay_extra_s),
+            "src": self.src,
+            "dst": self.dst,
+            "start_s": self.start_s,
+            "end_s": self.end_s,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "LinkFault":
+        return LinkFault(
+            drop_p=float(d.get("drop_p", 0.0)),
+            duplicate_p=float(d.get("duplicate_p", 0.0)),
+            delay_p=float(d.get("delay_p", 0.0)),
+            delay_extra_s=tuple(d.get("delay_extra_s", (0.05, 0.25))),
+            src=d.get("src"),
+            dst=d.get("dst"),
+            start_s=float(d.get("start_s", 0.0)),
+            end_s=None if d.get("end_s") is None else float(d["end_s"]),
+        )
+
+
+@dataclass(frozen=True)
+class PartitionFault:
+    """Timed blackhole partition between two groups.
+
+    ``symmetric=True`` drops both directions; ``False`` drops only
+    ``group_a -> group_b`` (the asymmetric cut: A's blocks vanish while A
+    still hears everything — the failure mode static partition tests miss).
+    """
+
+    start_s: float
+    end_s: float
+    group_a: Tuple[int, ...]
+    group_b: Tuple[int, ...]
+    symmetric: bool = True
+
+    def directed_pairs(self) -> List[Tuple[int, int]]:
+        pairs = [(a, b) for a in self.group_a for b in self.group_b]
+        if self.symmetric:
+            pairs += [(b, a) for a in self.group_a for b in self.group_b]
+        return sorted(set(pairs))
+
+    def to_dict(self) -> dict:
+        return {
+            "start_s": self.start_s,
+            "end_s": self.end_s,
+            "group_a": list(self.group_a),
+            "group_b": list(self.group_b),
+            "symmetric": self.symmetric,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PartitionFault":
+        return PartitionFault(
+            start_s=float(d["start_s"]),
+            end_s=float(d["end_s"]),
+            group_a=tuple(int(a) for a in d["group_a"]),
+            group_b=tuple(int(b) for b in d["group_b"]),
+            symmetric=bool(d.get("symmetric", True)),
+        )
+
+
+@dataclass(frozen=True)
+class CrashFault:
+    """Crash-restart of a whole validator.
+
+    At ``at_s`` the node's links break, its tasks are torn down, and its WAL
+    is closed; ``downtime_s`` later it is rebuilt FROM THE SAME WAL via the
+    ``BlockStore.open`` recovery path and rejoins the fleet.
+    ``torn_tail_bytes > 0`` truncates that many bytes off the WAL after the
+    crash, simulating a tear mid-entry (loss of the last un-synced write):
+    replay must stop cleanly at the tear and recovery truncates the torn
+    bytes before the first new append.
+    """
+
+    node: int
+    at_s: float
+    downtime_s: float
+    torn_tail_bytes: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "node": self.node,
+            "at_s": self.at_s,
+            "downtime_s": self.downtime_s,
+            "torn_tail_bytes": self.torn_tail_bytes,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "CrashFault":
+        return CrashFault(
+            node=int(d["node"]),
+            at_s=float(d["at_s"]),
+            downtime_s=float(d["downtime_s"]),
+            torn_tail_bytes=int(d.get("torn_tail_bytes", 0)),
+        )
+
+
+@dataclass
+class FaultPlan:
+    """The whole declarative scenario; ``seed`` drives BOTH the simulator's
+    loop RNG and the engine's per-message fault draws."""
+
+    seed: int = 0
+    link_faults: List[LinkFault] = field(default_factory=list)
+    partitions: List[PartitionFault] = field(default_factory=list)
+    crashes: List[CrashFault] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "link_faults": [f.to_dict() for f in self.link_faults],
+            "partitions": [p.to_dict() for p in self.partitions],
+            "crashes": [c.to_dict() for c in self.crashes],
+        }
+
+    def to_json(self) -> str:
+        return _canonical_json(self.to_dict())
+
+    @staticmethod
+    def from_dict(d: dict) -> "FaultPlan":
+        return FaultPlan(
+            seed=int(d.get("seed", 0)),
+            link_faults=[LinkFault.from_dict(f) for f in d.get("link_faults", [])],
+            partitions=[
+                PartitionFault.from_dict(p) for p in d.get("partitions", [])
+            ],
+            crashes=[CrashFault.from_dict(c) for c in d.get("crashes", [])],
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "FaultPlan":
+        return FaultPlan.from_dict(json.loads(text))
+
+
+def _canonical_json(obj) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def resolve_schedule(plan: FaultPlan) -> List[dict]:
+    """The plan's timed events, resolved up-front from the plan ALONE.
+
+    Purely a function of the plan (no RNG, no sim state), so it is trivially
+    byte-identical across runs — the determinism the fault LOG then extends
+    to the per-message draws.  Events at equal times keep a stable total
+    order via their sequence number.
+    """
+    events: List[dict] = []
+    for p in plan.partitions:
+        events.append(
+            {"t": p.start_s, "kind": "partition_start", **p.to_dict()}
+        )
+        events.append({"t": p.end_s, "kind": "partition_end", **p.to_dict()})
+    for c in plan.crashes:
+        events.append({"t": c.at_s, "kind": "crash", **c.to_dict()})
+        events.append(
+            {"t": c.at_s + c.downtime_s, "kind": "restart", "node": c.node}
+        )
+    events.sort(key=lambda e: (e["t"], e["kind"], _canonical_json(e)))
+    for seq, event in enumerate(events):
+        event["seq"] = seq
+    return events
+
+
+def schedule_bytes(plan: FaultPlan) -> bytes:
+    return _canonical_json(resolve_schedule(plan)).encode()
+
+
+# ---------------------------------------------------------------------------
+# Safety checker
+
+
+class SafetyViolation(AssertionError):
+    """Two different leader anchors committed at the same height."""
+
+
+class SafetyChecker:
+    """Cross-node, cross-restart commit auditor.
+
+    Commits are recorded by (authority, height) as they happen — including
+    re-observations after a WAL-replay restart, which MUST agree with what
+    the node committed before crashing.  :meth:`check` then asserts the
+    global invariant: at every height, all nodes that committed it committed
+    the same anchor (prefix consistency of committed leader sequences).
+    """
+
+    def __init__(self) -> None:
+        self._anchors: Dict[int, Dict[int, BlockReference]] = {}
+        # First mid-run violation, re-raised by check(): an observe() raise
+        # inside a node's accept pipeline is logged there, not propagated,
+        # so the end-of-run audit must still fail the scenario.
+        self._violation: Optional[SafetyViolation] = None
+
+    def observe(self, authority: int, committed) -> None:
+        """Record a node's freshly committed sub-dags (List[CommittedSubDag])."""
+        mine = self._anchors.setdefault(authority, {})
+        for commit in committed:
+            prev = mine.get(commit.height)
+            if prev is not None and prev != commit.anchor:
+                violation = SafetyViolation(
+                    f"authority {authority} committed two anchors at height "
+                    f"{commit.height}: {prev!r} then {commit.anchor!r}"
+                )
+                if self._violation is None:
+                    self._violation = violation
+                raise violation
+            mine[commit.height] = commit.anchor
+
+    def committed_height(self, authority: int) -> int:
+        mine = self._anchors.get(authority)
+        return max(mine) if mine else 0
+
+    def sequence(self, authority: int) -> List[BlockReference]:
+        """The node's committed anchors in height order; raises on gaps
+        (a hole means commits were observed out of linearizer order)."""
+        mine = self._anchors.get(authority, {})
+        out: List[BlockReference] = []
+        for expect, height in enumerate(sorted(mine), start=1):
+            if height != expect:
+                raise SafetyViolation(
+                    f"authority {authority} has a commit gap at height "
+                    f"{expect} (next observed: {height})"
+                )
+            out.append(mine[height])
+        return out
+
+    def check(self) -> None:
+        """Global prefix consistency: same anchor at every shared height."""
+        if self._violation is not None:
+            raise self._violation
+        golden: Dict[int, Tuple[BlockReference, int]] = {}
+        for authority in sorted(self._anchors):
+            self.sequence(authority)  # per-node contiguity
+            for height, anchor in self._anchors[authority].items():
+                prev = golden.get(height)
+                if prev is None:
+                    golden[height] = (anchor, authority)
+                elif prev[0] != anchor:
+                    raise SafetyViolation(
+                        f"fork at height {height}: authority {prev[1]} "
+                        f"committed {prev[0]!r}, authority {authority} "
+                        f"committed {anchor!r}"
+                    )
+
+
+class _CheckedCommitObserver(TestCommitObserver):
+    """TestCommitObserver that feeds every commit to the SafetyChecker."""
+
+    def __init__(self, checker: SafetyChecker, authority: int, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._checker = checker
+        self._checked_authority = authority
+
+    def handle_commit(self, committed_leaders):
+        committed = super().handle_commit(committed_leaders)
+        self._checker.observe(self._checked_authority, committed)
+        return committed
+
+
+# ---------------------------------------------------------------------------
+# Harness: an N-validator sim fleet whose nodes survive crash-restart
+
+
+class _SimNodeNetwork:
+    """Adapter giving NetworkSyncer the TcpNetwork surface over the sim."""
+
+    def __init__(self, queue: asyncio.Queue) -> None:
+        self.connections = queue
+
+    async def stop(self) -> None:
+        pass
+
+
+class ChaosSimHarness:
+    """N validators over :class:`SimulatedNetwork` with per-node WAL files.
+
+    Unlike the plain sim-test fleets, nodes here are individually crashable:
+    :meth:`crash` tears a node down (links break first — a dead node stops
+    talking mid-protocol — then tasks, then the WAL), and :meth:`restart`
+    rebuilds the validator from the same WAL path, driving the full
+    ``BlockStore.open`` -> ``Core`` recovery path, and reconnects it.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        wal_dir: str,
+        parameters: Optional[Parameters] = None,
+        committee: Optional[Committee] = None,
+        verifier_factory=None,
+        with_metrics: bool = False,
+    ) -> None:
+        self.n = n
+        self.wal_dir = wal_dir
+        self.committee = committee or Committee.new_test([1] * n)
+        self.signers = Committee.benchmark_signers(n)
+        self.parameters = parameters or Parameters(leader_timeout_s=1.0)
+        # (authority, committee, metrics) -> BlockVerifier, or None for the
+        # AcceptAll default (chaos scenarios that are not about the verifier
+        # keep the sim fully single-threaded, hence bit-reproducible).
+        self.verifier_factory = verifier_factory
+        # One Metrics per authority, SHARED across restarts, so counters like
+        # crash_recovery_total accumulate over the node's whole life.
+        self.metrics: List[Optional[Metrics]] = [
+            Metrics() if with_metrics else None for _ in range(n)
+        ]
+        self.checker = SafetyChecker()
+        self.sim_net = SimulatedNetwork(n)
+        self.nodes: List[Optional[NetworkSyncer]] = [None] * n
+        self.down: Set[int] = set()
+
+    def _wal_path(self, authority: int) -> str:
+        return os.path.join(self.wal_dir, f"wal-{authority}")
+
+    def _build_node(self, authority: int) -> NetworkSyncer:
+        wal_writer, wal_reader = walf(self._wal_path(authority))
+        recovered, observer_recovered = BlockStore.open(
+            authority, wal_reader, wal_writer, self.committee,
+            self.metrics[authority],
+        )
+        handler = TestBlockHandler(
+            last_transaction=authority * 1_000_000,
+            committee=self.committee,
+            authority=authority,
+        )
+        core = Core(
+            block_handler=handler,
+            authority=authority,
+            committee=self.committee,
+            parameters=self.parameters,
+            recovered=recovered,
+            wal_writer=wal_writer,
+            options=CoreOptions.test(),
+            signer=self.signers[authority],
+            metrics=self.metrics[authority],
+        )
+        observer = _CheckedCommitObserver(
+            self.checker,
+            authority,
+            core.block_store,
+            self.committee,
+            recovered_state=observer_recovered,
+        )
+        verifier = (
+            self.verifier_factory(
+                authority, self.committee, self.metrics[authority]
+            )
+            if self.verifier_factory is not None
+            else None
+        )
+        return NetworkSyncer(
+            core,
+            observer,
+            _SimNodeNetwork(self.sim_net.node_connections[authority]),
+            parameters=self.parameters,
+            block_verifier=verifier,
+            metrics=self.metrics[authority],
+        )
+
+    async def start(self) -> None:
+        for authority in range(self.n):
+            node = self._build_node(authority)
+            self.nodes[authority] = node
+            await node.start()
+        await self.sim_net.connect_all()
+
+    async def crash(self, authority: int, torn_tail_bytes: int = 0) -> None:
+        node = self.nodes[authority]
+        assert node is not None, f"authority {authority} is already down"
+        self.down.add(authority)
+        self.sim_net.crash(authority)
+        await node.stop()
+        # Close the WAL cleanly (drains the async appender): the baseline
+        # crash model is "durable up to the last acknowledged append".  The
+        # torn tail below then simulates the STRONGER loss — a write cut
+        # mid-entry — on top of it.
+        node.core.wal_writer.close()
+        node.core.block_store.close()
+        self.nodes[authority] = None
+        if torn_tail_bytes > 0:
+            path = self._wal_path(authority)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(0, size - torn_tail_bytes))
+
+    async def restart(self, authority: int) -> NetworkSyncer:
+        assert authority in self.down, f"authority {authority} is not down"
+        node = self._build_node(authority)  # WAL replay happens here
+        self.nodes[authority] = node
+        await node.start()
+        self.down.discard(authority)
+        await self.sim_net.restart(authority)
+        return node
+
+    async def stop(self) -> None:
+        for node in self.nodes:
+            if node is None:
+                continue
+            await node.stop()
+            node.core.wal_writer.close()
+            node.core.block_store.close()
+        self.sim_net.close()
+
+    # -- commit accessors (all via the checker: restart-proof) --
+
+    def committed_height(self, authority: int) -> int:
+        return self.checker.committed_height(authority)
+
+    def sequences(self) -> Dict[int, List[BlockReference]]:
+        return {a: self.checker.sequence(a) for a in range(self.n)}
+
+
+# ---------------------------------------------------------------------------
+# The engine
+
+
+class ChaosEngine:
+    """Executes a :class:`FaultPlan` against a :class:`ChaosSimHarness`.
+
+    Acts as BOTH the timed-event scheduler (partitions, crash-restarts) and
+    the :class:`SimulatedNetwork` fault injector (per-message drop /
+    duplicate / delay draws from a plan-seeded RNG).  Every injected fault
+    is appended to a log whose canonical-JSON serialization
+    (:meth:`fault_log_bytes`) is byte-identical across same-seed runs —
+    message order is deterministic under the DeterministicLoop, and the
+    draws consume a dedicated ``Random`` in that order.
+    """
+
+    def __init__(self, harness: ChaosSimHarness, plan: FaultPlan) -> None:
+        self.harness = harness
+        self.plan = plan
+        self.events = resolve_schedule(plan)
+        self._link_rng = random.Random((plan.seed << 1) ^ 0x5EEDFA17)
+        self._blocked: Set[Tuple[int, int]] = set()
+        self._log: List[dict] = []
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle --
+
+    def start(self) -> "ChaosEngine":
+        self.harness.sim_net.fault_injector = self
+        self._task = spawn_logged(self._run(), log, name="chaos-engine")
+        return self
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self.harness.sim_net.fault_injector = None
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        for event in self.events:
+            delay = event["t"] - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await self._execute(event)
+
+    async def _execute(self, event: dict) -> None:
+        kind = event["kind"]
+        if kind == "partition_start":
+            fault = PartitionFault.from_dict(event)
+            self._blocked.update(fault.directed_pairs())
+            self._record(kind, pairs=len(fault.directed_pairs()))
+        elif kind == "partition_end":
+            fault = PartitionFault.from_dict(event)
+            self._blocked.difference_update(fault.directed_pairs())
+            self._record(kind, pairs=len(fault.directed_pairs()))
+        elif kind == "crash":
+            node = event["node"]
+            height = self.harness.committed_height(node)
+            await self.harness.crash(
+                node, torn_tail_bytes=event.get("torn_tail_bytes", 0)
+            )
+            self._count_fault(node, "crash")
+            self._record(
+                kind, node=node, committed_height=height,
+                torn_tail_bytes=event.get("torn_tail_bytes", 0),
+            )
+        elif kind == "restart":
+            node = event["node"]
+            await self.harness.restart(node)
+            self._count_fault(node, "restart")
+            self._record(
+                kind, node=node,
+                committed_height=self.harness.committed_height(node),
+            )
+
+    # -- fault injector surface (SimulatedNetwork._pump) --
+
+    def filter_batch(self, src: int, dst: int, batch: list) -> List[tuple]:
+        if (src, dst) in self._blocked:
+            self._count_fault(dst, "blackhole")
+            self._record("blackhole", src=src, dst=dst, n=len(batch))
+            return []
+        t = asyncio.get_event_loop().time()
+        rule = next(
+            (f for f in self.plan.link_faults if f.matches(src, dst, t)), None
+        )
+        if rule is None:
+            return [(0.0, batch)]
+        rng = self._link_rng
+        on_time: List = []
+        extra_groups: List[tuple] = []
+        dropped = duplicated = delayed = 0
+        for message in batch:
+            if rule.drop_p > 0.0 and rng.random() < rule.drop_p:
+                dropped += 1
+                continue
+            if rule.delay_p > 0.0 and rng.random() < rule.delay_p:
+                extra_groups.append(
+                    (rng.uniform(*rule.delay_extra_s), [message])
+                )
+                delayed += 1
+            else:
+                on_time.append(message)
+            if rule.duplicate_p > 0.0 and rng.random() < rule.duplicate_p:
+                extra_groups.append(
+                    (rng.uniform(*rule.delay_extra_s), [message])
+                )
+                duplicated += 1
+        if dropped or duplicated or delayed:
+            for kind, count in (
+                ("drop", dropped), ("duplicate", duplicated), ("delay", delayed),
+            ):
+                if count:
+                    self._count_fault(dst, kind, count)
+            self._record(
+                "link_faults", src=src, dst=dst,
+                dropped=dropped, duplicated=duplicated, delayed=delayed,
+            )
+        return [(0.0, on_time)] + extra_groups
+
+    # -- bookkeeping --
+
+    def _record(self, kind: str, **fields) -> None:
+        entry = {"t": asyncio.get_event_loop().time(), "kind": kind}
+        entry.update(fields)
+        self._log.append(entry)
+
+    def _count_fault(self, node: int, kind: str, count: int = 1) -> None:
+        metrics = self.harness.metrics[node]
+        if metrics is not None:
+            metrics.chaos_faults_total.labels(kind).inc(count)
+
+    @property
+    def fault_log(self) -> List[dict]:
+        return list(self._log)
+
+    def fault_log_bytes(self) -> bytes:
+        return _canonical_json(self._log).encode()
+
+    def fault_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for entry in self._log:
+            if entry["kind"] == "link_faults":
+                for key in ("dropped", "duplicated", "delayed"):
+                    counts[key] = counts.get(key, 0) + entry[key]
+            else:
+                counts[entry["kind"]] = counts.get(entry["kind"], 0) + 1
+        return counts
+
+
+# ---------------------------------------------------------------------------
+# One-call runner (tests + the `chaos` CLI subcommand)
+
+
+@dataclass
+class ChaosReport:
+    """Everything a scenario needs to assert on (or a human to read)."""
+
+    sequences: Dict[int, List[BlockReference]]
+    fault_log: List[dict]
+    fault_log_bytes: bytes
+    schedule_bytes: bytes
+    fault_counts: Dict[str, int]
+    crash_events: List[dict]
+
+    def schedule_digest(self) -> str:
+        return hashlib.sha256(self.fault_log_bytes).hexdigest()
+
+
+def run_chaos_sim(
+    plan: FaultPlan,
+    n: int,
+    duration_s: float,
+    wal_dir: str,
+    parameters: Optional[Parameters] = None,
+    verifier_factory=None,
+    with_metrics: bool = False,
+    extra_fault=None,
+) -> Tuple[ChaosReport, ChaosSimHarness]:
+    """Run one chaos scenario to completion on a fresh DeterministicLoop.
+
+    Returns the report plus the (stopped) harness so callers can inspect
+    per-node metrics.  ``extra_fault(harness) -> awaitable`` is an optional
+    test hook scheduled alongside the plan (e.g. killing an injected
+    verifier backend mid-run).  Raises :class:`SafetyViolation` if any
+    committed prefix ever diverged.
+    """
+    from .runtime.simulated import run_simulation
+
+    harness = ChaosSimHarness(
+        n,
+        wal_dir,
+        parameters=parameters,
+        verifier_factory=verifier_factory,
+        with_metrics=with_metrics,
+    )
+    engine = ChaosEngine(harness, plan)
+
+    async def main() -> ChaosReport:
+        await harness.start()
+        engine.start()
+        extra = (
+            spawn_logged(extra_fault(harness), log, name="chaos-extra-fault")
+            if extra_fault is not None
+            else None
+        )
+        await asyncio.sleep(duration_s)
+        engine.stop()
+        if extra is not None:
+            extra.cancel()
+        await harness.stop()
+        harness.checker.check()
+        return ChaosReport(
+            sequences=harness.sequences(),
+            fault_log=engine.fault_log,
+            fault_log_bytes=engine.fault_log_bytes(),
+            schedule_bytes=schedule_bytes(plan),
+            fault_counts=engine.fault_counts(),
+            crash_events=[e for e in engine.fault_log if e["kind"] == "crash"],
+        )
+
+    return run_simulation(main(), seed=plan.seed), harness

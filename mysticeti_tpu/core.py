@@ -100,6 +100,10 @@ class Core:
                 if isinstance(meta, Include):
                     threshold_clock.add_block(meta.reference, committee)
             last_own_block = recovered.last_own_block
+            if metrics is not None:
+                # WAL-recovered boot (vs genesis bootstrap): the chaos tier
+                # asserts crash-restart actually drove this path.
+                metrics.crash_recovery_total.inc()
         else:
             assert not pending
             own_genesis, other_genesis = committee.genesis_blocks(authority)

@@ -272,6 +272,27 @@ class Metrics:
             "in-flight verify requests per service client connection",
             labels=("connection",),
         )
+        self.verifier_fallback_total = counter(
+            "verifier_fallback_total",
+            "signature batches degraded to the CPU oracle because the "
+            "accelerator path was unavailable (circuit breaker open or "
+            "dispatch failed)",
+        )
+        self.verifier_reconnect_total = counter(
+            "verifier_reconnect_total",
+            "verifier-service client connections torn down and retried",
+        )
+
+        # Robustness / chaos engineering.
+        self.crash_recovery_total = counter(
+            "crash_recovery_total",
+            "node boots that recovered state by replaying a non-empty WAL",
+        )
+        self.chaos_faults_total = counter(
+            "chaos_faults_total",
+            "faults injected by the deterministic chaos engine",
+            labels=("kind",),
+        )
 
         # Utilization timers (metrics.rs:615-666).
         self.utilization_timer_us = counter(

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import random
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -34,6 +35,18 @@ log = logger(__name__)
 HANDSHAKE_MAGIC = 0x7C9A_11B7
 MAX_FRAME = 16 * 1024 * 1024
 PING_INTERVAL_S = 30.0
+
+def jittered_backoff(delay: float, rng: random.Random) -> float:
+    """Uniform [0.5, 1.5)x jitter around an exponential-backoff delay.
+
+    A bare doubling schedule synchronizes reconnect storms: every dialer that
+    lost the same peer at the same moment retries on the same beat, hammering
+    the recovering node in lockstep bursts.  The multiplicative jitter keeps
+    the expected delay while decorrelating the fleet; callers pass a SEEDED
+    rng so simulated runs stay reproducible.
+    """
+    return delay * (0.5 + rng.random())
+
 
 _MSG_SUBSCRIBE = 1
 _MSG_BLOCKS = 2
@@ -169,9 +182,17 @@ class Connection:
     async def recv(self) -> Optional[NetworkMessage]:
         get = asyncio.ensure_future(self.receiver.get())
         closed = asyncio.ensure_future(self._closed.wait())
-        done, pending = await asyncio.wait(
-            {get, closed}, return_when=asyncio.FIRST_COMPLETED
-        )
+        try:
+            done, pending = await asyncio.wait(
+                {get, closed}, return_when=asyncio.FIRST_COMPLETED
+            )
+        except asyncio.CancelledError:
+            # A connection task torn down mid-recv (node crash/stop) must not
+            # orphan the two helper tasks — they would linger pending until
+            # loop close ("Task was destroyed but it is pending").
+            get.cancel()
+            closed.cancel()
+            raise
         for p in pending:
             p.cancel()
         if get in done:
@@ -270,7 +291,12 @@ class TcpNetwork:
     # -- outbound --
 
     async def _dial_worker(self, peer: int) -> None:
-        """Reconnect-forever loop (network.rs:218-242)."""
+        """Reconnect-forever loop (network.rs:218-242), with seeded jitter on
+        the backoff (the simulator's loop RNG when present, else a
+        per-(dialer, peer) seed) so fleet-wide reconnect storms decorrelate."""
+        rng = getattr(asyncio.get_event_loop(), "rng", None) or random.Random(
+            (self.authority << 20) ^ peer
+        )
         delay = 0.1
         while not self._stopped:
             try:
@@ -293,7 +319,7 @@ class TcpNetwork:
             except (OSError, asyncio.IncompleteReadError, ConnectionError, SerdeError,
                     asyncio.TimeoutError) as exc:
                 log.debug("dial to authority %d failed: %r (retrying)", peer, exc)
-            await asyncio.sleep(delay)
+            await asyncio.sleep(jittered_backoff(delay, rng))
             delay = min(delay * 2, 5.0)
 
     # -- shared read/write/ping loops --

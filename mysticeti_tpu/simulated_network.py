@@ -33,6 +33,12 @@ class SimulatedNetwork:
         ]
         self._links: Dict[Tuple[int, int], tuple] = {}  # (ca, cb, pump_a, pump_b)
         self._severed: Set[Tuple[int, int]] = set()
+        self._down: Set[int] = set()
+        # Chaos seam (chaos.py): when set, every src->dst batch is routed
+        # through ``filter_batch(src, dst, batch) -> [(extra_delay_s,
+        # messages), ...]`` which may drop, duplicate, or delay individual
+        # messages.  None = faithful delivery (one group, zero extra delay).
+        self.fault_injector = None
 
     async def connect_all(self) -> None:
         for a in range(self.n):
@@ -76,15 +82,28 @@ class SimulatedNetwork:
                 except asyncio.QueueEmpty:
                     break
 
-            def deliver(ms=batch):
-                if not c_dst.is_closed():
-                    for m in ms:
-                        try:
-                            c_dst.receiver.put_nowait(m)
-                        except asyncio.QueueFull:
-                            break
+            injector = self.fault_injector
+            groups = (
+                [(0.0, batch)]
+                if injector is None
+                else injector.filter_batch(src, dst, batch)
+            )
+            if not groups:
+                continue
+            base_latency = self._latency()
+            for extra_delay, messages in groups:
+                if not messages:
+                    continue
 
-            loop.call_later(self._latency(), deliver)
+                def deliver(ms=messages):
+                    if not c_dst.is_closed():
+                        for m in ms:
+                            try:
+                                c_dst.receiver.put_nowait(m)
+                            except asyncio.QueueFull:
+                                break
+
+                loop.call_later(base_latency + extra_delay, deliver)
 
     # -- fault injection --
 
@@ -111,11 +130,44 @@ class SimulatedNetwork:
     def isolate(self, node: int) -> None:
         self.partition([node], [i for i in range(self.n) if i != node])
 
+    def crash(self, node: int) -> None:
+        """Take a node off the network abruptly: every link breaks (peers
+        observe closure mid-protocol) and queued-but-unaccepted fresh
+        connections are discarded, so a restarted node's accept loop only
+        ever sees post-restart connections."""
+        self._down.add(node)
+        for peer in range(self.n):
+            if peer != node:
+                self._sever(node, peer)
+        queue = self.node_connections[node]
+        while True:
+            try:
+                queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+
+    async def restart(self, node: int) -> None:
+        """Bring a crashed node back: re-establish links to every live peer
+        (both ends receive fresh Connection objects, re-running the
+        subscribe/catch-up path exactly like a healed partition)."""
+        self._down.discard(node)
+        for key in sorted(k for k in self._severed if node in k):
+            a, b = key
+            other = b if a == node else a
+            if other in self._down:
+                continue
+            self._severed.discard(key)
+            await self._connect_pair(a, b)
+
     async def heal(self) -> None:
         """Reconnect every severed pair (the reconnect-forever workers' job in
-        the real transport, network.rs:218-242)."""
+        the real transport, network.rs:218-242).  Pairs touching a crashed
+        node stay severed until that node restarts."""
         severed, self._severed = self._severed, set()
         for a, b in sorted(severed):
+            if a in self._down or b in self._down:
+                self._severed.add((a, b))
+                continue
             await self._connect_pair(a, b)
 
     def close(self) -> None:

@@ -95,7 +95,7 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
             from .verifier_service import RemoteSignatureVerifier
 
             tpu_backend = RemoteSignatureVerifier(
-                committee_keys=committee_keys
+                committee_keys=committee_keys, metrics=metrics
             )
         else:
             tpu_backend = TpuSignatureVerifier(committee_keys=committee_keys)
@@ -314,6 +314,10 @@ class Validator:
             await self.network_syncer.stop()
         if self.core is not None:
             self.core.wal_writer.close()
+            # Release the WAL reader too (fd + whole-file mmap): embeddings
+            # that cycle validators in one process would otherwise leak one
+            # of each per stop.
+            self.core.block_store.close()
 
     def committed_leaders(self) -> List:
         observer = self.network_syncer.syncer.commit_observer

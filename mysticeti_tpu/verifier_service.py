@@ -47,13 +47,16 @@ from __future__ import annotations
 import asyncio
 import itertools
 import os
+import random
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
-from .block_validator import SignatureVerifier
+from .block_validator import SignatureVerifier, VerifierProtocolError
+from .network import jittered_backoff
 from .tracing import logger
 
 log = logger(__name__)
@@ -69,6 +72,11 @@ _IDX_REC = 2 + 32 + 64  # u16 idx | digest | sig
 _RAW_REC = 32 + 32 + 64
 
 ENV_SOCKET = "MYSTICETI_VERIFIER_SOCKET"
+
+# VerifierProtocolError (re-exported above from block_validator): the service
+# answered but REJECTED the request.  Excluded from the client's retry loop
+# AND from the hybrid circuit breaker — a misconfigured validator fails fast
+# instead of hammering the service or silently degrading to the oracle.
 
 
 def _frame(type_: int, payload: bytes) -> bytes:
@@ -338,13 +346,26 @@ class RemoteSignatureVerifier(SignatureVerifier):
 
     backend_label = "tpu-remote"
 
+    # Reconnect-retry budget per request: a service restart mid-burst is
+    # routine (seconds of downtime), a fleet boot race is routine — neither
+    # is an outage.  Only exhausting the budget propagates, and the hybrid
+    # circuit breaker takes it from there.
+    MAX_ATTEMPTS = 4
+    RETRY_BASE_BACKOFF_S = 0.05
+    RETRY_MAX_BACKOFF_S = 1.0
+
     def __init__(self, socket_path: Optional[str] = None,
                  committee_keys: Optional[Sequence[bytes]] = None,
-                 timeout_s: float = 300.0) -> None:
+                 timeout_s: float = 300.0,
+                 metrics=None,
+                 max_attempts: Optional[int] = None) -> None:
         self.socket_path = socket_path or os.environ[ENV_SOCKET]
         self._keys = list(committee_keys or [])
         self._index = {pk: i for i, pk in enumerate(self._keys)}
         self.timeout_s = timeout_s
+        self.metrics = metrics
+        self.max_attempts = max_attempts or self.MAX_ATTEMPTS
+        self._retry_rng = random.Random(0x5E7C1E27)
         self._tls = threading.local()
         # (fixed_dispatch_s, per_sig_s) as measured by the SERVICE on its
         # own warmed backend (HELLO_OK payload); None until first connect.
@@ -361,7 +382,7 @@ class RemoteSignatureVerifier(SignatureVerifier):
         type_, reply = self._read_frame(conn)
         if type_ != T_HELLO_OK:
             conn.close()
-            raise ConnectionError(
+            raise VerifierProtocolError(
                 f"verifier service rejected hello: {reply.decode(errors='replace')}"
             )
         if len(reply) == 16:
@@ -399,25 +420,43 @@ class RemoteSignatureVerifier(SignatureVerifier):
         return type_, payload
 
     def _roundtrip(self, frame: bytes, req_id: int) -> bytes:
-        """Send one request; on a stale/broken connection, reconnect ONCE
-        (the service restarting between fleets is normal; a second failure
-        is a real outage and propagates)."""
-        for attempt in (0, 1):
-            conn = self._conn()
+        """Send one request with bounded reconnect-retries.
+
+        The round-5 reconnect-ONCE policy made a service restart during a
+        fleet burst a fatal outage: every in-flight thread burned its single
+        retry against the not-yet-listening socket and propagated.  Retries
+        are bounded (``max_attempts``) with jittered exponential backoff so
+        a thundering herd of dispatch threads does not hammer the recovering
+        service in lockstep; each torn-down connection counts on
+        ``verifier_reconnect_total``.  Protocol rejections
+        (:class:`VerifierProtocolError`) are never retried, and exhausting
+        the budget propagates — the hybrid circuit breaker takes it from
+        there."""
+        backoff = self.RETRY_BASE_BACKOFF_S
+        for attempt in range(self.max_attempts):
             try:
+                conn = self._conn()
                 conn.sendall(frame)
                 type_, payload = self._read_frame(conn)
                 break
+            except VerifierProtocolError:
+                raise
             except (ConnectionError, OSError, socket.timeout):
+                stale = getattr(self._tls, "conn", None)
                 self._tls.conn = None
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                if attempt:
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
+                if self.metrics is not None:
+                    self.metrics.verifier_reconnect_total.inc()
+                if attempt + 1 >= self.max_attempts:
                     raise
+                time.sleep(jittered_backoff(backoff, self._retry_rng))
+                backoff = min(backoff * 2.0, self.RETRY_MAX_BACKOFF_S)
         if type_ == T_ERR:
-            raise ConnectionError(
+            raise VerifierProtocolError(
                 f"verifier service error: {payload.decode(errors='replace')}"
             )
         assert type_ == T_RESULT

@@ -215,6 +215,22 @@ class WalWriter:
         if self._error is not None:
             raise self._error
 
+    def truncate_to(self, position: WalPosition) -> None:
+        """Discard a torn tail discovered during recovery.
+
+        Replay stops at the first corrupt entry; everything past it was never
+        acknowledged.  Appends must resume AT the tear, not after it: a new
+        entry written past the torn bytes would be unreachable on the next
+        replay (iteration stops at the tear forever), silently losing every
+        subsequent acknowledged write.  Recovery calls this before the first
+        post-restart append (block_store.py:open)."""
+        assert not self._closed
+        assert position <= self._pos
+        self.flush()  # nothing should be queued at recovery time; be safe
+        os.ftruncate(self._fd, position)
+        os.lseek(self._fd, 0, os.SEEK_END)
+        self._pos = position
+
     def position(self) -> WalPosition:
         return self._pos
 
