@@ -77,8 +77,13 @@ GUARDED_FIELDS: Dict[str, str] = {
     # Hybrid verifier circuit breaker: tripped/probed/closed from concurrent
     # dispatch threads; shares the EMA lock (same writers, same cadence).
     "_breaker_backoff_s": "_ema_lock",
+    "_breaker_gen": "_ema_lock",
     "_breaker_open_until": "_ema_lock",
     "_breaker_probing": "_ema_lock",
+    # RemoteSignatureVerifier's staged-dispatch connection pool: checked
+    # out/in from any executor thread; the live-connection count must move
+    # with the deque under one lock or the bound drifts.
+    "_pool_size": "_pool_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

@@ -27,8 +27,17 @@ from . import spans
 from .committee import Committee
 from .network import jittered_backoff
 from .tracing import logger
+from .runtime import is_simulated
 from .types import StatementBlock, VerificationError
 from .utils.tasks import spawn_logged
+from .verify_pipeline import (
+    STAGE_DEVICE,
+    STAGE_FETCH,
+    STAGE_PACK,
+    CompletedDispatch,
+    DeferredDispatch,
+    VerifyPipeline,
+)
 
 log = logger(__name__)
 
@@ -78,6 +87,18 @@ class SignatureVerifier:
         signatures: Sequence[bytes],
     ) -> List[bool]:
         raise NotImplementedError
+
+    def verify_signatures_async(self, public_keys, digests, signatures):
+        """Staged-dispatch seam: submit without blocking, returning a handle
+        whose ``result()`` blocks until the verdicts are ready.  Backends
+        with a real async queue (JAX dispatch, the verifier-service socket)
+        override this so the device computes while the host packs the next
+        batch; the default defers the synchronous path to ``result()`` —
+        host backends have no device queue to exploit, and the pipeline's
+        fetch stage runs them on concurrent executor threads anyway."""
+        return DeferredDispatch(
+            self.verify_signatures, public_keys, digests, signatures
+        )
 
     def warmup(self) -> None:
         """Optional: pay one-time costs (tracing, compilation) before the
@@ -161,34 +182,42 @@ class TpuSignatureVerifier(SignatureVerifier):
 
         return sum(bucket for _, _, bucket in iter_buckets(n))
 
-    def verify_signatures(self, public_keys, digests, signatures):
+    def verify_signatures_async(self, public_keys, digests, signatures):
+        """True async dispatch: pack on the calling (host) thread, submit
+        every bucket chunk through JAX's async dispatch, return the device
+        handle.  ``result()`` pays the single combined fetch — so large
+        catch-up batches stream bucket-sized sub-dispatches through the
+        device while the caller packs the next batch."""
         mesh = self._resolve_mesh()
         # The fused sharded kernel requires 32-byte messages (block digests);
         # other lengths fall back to the single-device host-hash path so the
         # result never depends on the device count.
         if mesh is not None and all(len(d) == 32 for d in digests):
             if self._table is not None:
-                from .parallel.mesh import sharded_verify_batch_indexed
+                from .parallel.mesh import dispatch_sharded_indexed
 
-                ok, _ = sharded_verify_batch_indexed(
+                return dispatch_sharded_indexed(
                     mesh, self._table, public_keys, digests, signatures
                 )
-                return list(ok)
-            from .parallel.mesh import sharded_verify_batch_fused
+            from .parallel.mesh import dispatch_sharded_fused
 
-            ok, _ = sharded_verify_batch_fused(
+            return dispatch_sharded_fused(
                 mesh, public_keys, digests, signatures
             )
-            return list(ok)
         from .ops import ed25519
 
         if self._table is not None:
-            return list(
-                ed25519.verify_batch_table(
-                    self._table, public_keys, digests, signatures
-                )
+            return ed25519.dispatch_batch_table(
+                self._table, public_keys, digests, signatures
             )
-        return list(ed25519.verify_batch(public_keys, digests, signatures))
+        return ed25519.dispatch_batch(public_keys, digests, signatures)
+
+    def verify_signatures(self, public_keys, digests, signatures):
+        return list(
+            self.verify_signatures_async(
+                public_keys, digests, signatures
+            ).result()
+        )
 
 
 def _update_ema(current: float, sample: float, outlier_s: float) -> float:
@@ -268,6 +297,10 @@ class HybridSignatureVerifier(SignatureVerifier):
         self._breaker_backoff_s = 0.0
         self._breaker_open_until = 0.0
         self._breaker_probing = False
+        # Trip generation: with several dispatches in flight, a PRE-outage
+        # success can surface at fetch AFTER a newer failure tripped the
+        # circuit — it must not re-close it (see result()).
+        self._breaker_gen = 0
         self._breaker_rng = random.Random(0x0B7EA6E5)
         self._breaker_clock = time.monotonic  # injectable for tests
         # Routing label of the dispatch that ran in THIS thread: the batching
@@ -340,24 +373,36 @@ class HybridSignatureVerifier(SignatureVerifier):
     def breaker_open(self) -> bool:
         return self._breaker_backoff_s > 0.0
 
-    def _breaker_blocks(self) -> bool:
-        """True while the breaker holds the accelerator route closed.  Once
-        the probe deadline passes, exactly ONE dispatch gets through as the
-        probe — the ``_breaker_probing`` flag (not a pushed deadline) keeps
-        it exclusive even when the probe outlives the backoff interval."""
+    def _admit_accelerator(self) -> Tuple[bool, bool]:
+        """(blocked, is_probe).  Blocked while the breaker holds the route
+        closed.  Once the probe deadline passes, exactly ONE dispatch gets
+        through as the probe — the ``_breaker_probing`` flag (not a pushed
+        deadline) keeps it exclusive even when the probe outlives the
+        backoff interval.  ``is_probe`` tells the admitted dispatch it OWNS
+        that flag: only the owner may release it on a non-verdict exit
+        (abandon, propagating non-breaker exception) — an unconditional
+        clear could release a DIFFERENT in-flight probe's exclusivity."""
         with self._ema_lock:
             if self._breaker_backoff_s == 0.0:
-                return False
+                return False, False
             now = self._breaker_clock()
             if self._breaker_probing or now < self._breaker_open_until:
-                return True
+                return True, False
             self._breaker_probing = True
-            return False
+            return False, True
 
-    def _trip_breaker(self, exc: BaseException) -> None:
+    def _trip_breaker(self, exc: BaseException,
+                      owns_probe: bool = False) -> None:
+        """Open (or widen) the circuit.  ``owns_probe`` mirrors the
+        ``is_probe`` admission flag: only the dispatch that OWNS the
+        exclusive probe slot may release it on failure — a pre-outage
+        straggler failing at fetch while a probe hangs must not readmit
+        victims behind the hung probe's back."""
         now = self._breaker_clock()
         with self._ema_lock:
-            self._breaker_probing = False
+            self._breaker_gen += 1
+            if owns_probe:
+                self._breaker_probing = False
             prev = self._breaker_backoff_s
             backoff = (
                 self.BREAKER_BASE_BACKOFF_S
@@ -373,13 +418,21 @@ class HybridSignatureVerifier(SignatureVerifier):
             "the CPU oracle; next probe in ~%.1f s", exc, backoff,
         )
 
-    def _close_breaker(self) -> None:
+    def _close_breaker(self, expected_gen: Optional[int] = None) -> bool:
+        """Close the circuit.  With ``expected_gen``, close only while the
+        breaker generation still matches — compared under the lock, so a
+        success surfacing at fetch can never erase a trip that raced it
+        between the caller's generation read and the close."""
         with self._ema_lock:
+            if (expected_gen is not None
+                    and expected_gen != self._breaker_gen):
+                return False
             was_open = self._breaker_backoff_s > 0.0
             self._breaker_backoff_s = 0.0
             self._breaker_probing = False
         if was_open:
             log.info("accelerator verify path recovered: circuit closed")
+        return True
 
     def _clear_probe(self) -> None:
         """Release probe exclusivity when the dispatch neither succeeded nor
@@ -448,39 +501,61 @@ class HybridSignatureVerifier(SignatureVerifier):
                 abs(actual_s - estimated_s)
             )
 
-    def verify_signatures(self, public_keys, digests, signatures):
+    def verify_signatures_async(self, public_keys, digests, signatures):
+        """Staged routing: a TPU-routed batch submits through the backend's
+        own async queue (JAX dispatch, the service socket) and returns an
+        in-flight handle; a breaker failure AT FETCH degrades that one batch
+        to the oracle inside ``result()`` — zero lost futures.  CPU-routed
+        (and breaker-blocked) batches defer the oracle to the fetch stage
+        unchanged."""
         n = len(signatures)
         if n == 0:
-            return []
+            return CompletedDispatch([])
         degraded = False
         if self._route_to_tpu(n):
-            if self._breaker_blocks():
+            blocked, is_probe = self._admit_accelerator()
+            if blocked:
                 degraded = True  # circuit open: the route is held closed
             else:
+                # Captured BEFORE the submit: a trip racing the submission
+                # means this dispatch's eventual success is ambiguous
+                # evidence and must not close the circuit.
+                gen = self._breaker_gen
                 try:
-                    return self._verify_tpu(
-                        public_keys, digests, signatures, n
+                    handle = self.tpu.verify_signatures_async(
+                        public_keys, digests, signatures
                     )
                 except self.BREAKER_EXCEPTIONS as exc:
                     if isinstance(exc, VerifierProtocolError):
-                        # A rejection (committee mismatch, malformed frame)
-                        # is a configuration bug, not an outage: fail fast.
-                        self._clear_probe()
+                        if is_probe:
+                            self._clear_probe()
                         raise
-                    # Outage, not a verdict: trip the breaker and verify
-                    # THIS batch on the oracle — the dispatch thread (and
-                    # with it the whole batching collector) must survive a
-                    # dead accelerator.
-                    self._trip_breaker(exc)
+                    self._trip_breaker(exc, owns_probe=is_probe)
                     degraded = True
                 except BaseException:
-                    self._clear_probe()
+                    if is_probe:
+                        self._clear_probe()
                     raise
+                else:
+                    return _HybridTpuDispatch(
+                        self, handle, public_keys, digests, signatures, n,
+                        is_probe, gen,
+                    )
         if degraded and self.metrics is not None:
-            # One count per DEGRADED BATCH (matching the series help text),
-            # not per breaker trip.
             self.metrics.verifier_fallback_total.inc()
-        return self._verify_cpu(public_keys, digests, signatures, n)
+        return DeferredDispatch(
+            self._verify_cpu, public_keys, digests, signatures, n
+        )
+
+    def verify_signatures(self, public_keys, digests, signatures):
+        """One routing/breaker implementation for both call shapes: the
+        sync path is the async path fetched immediately (submit-time breaker
+        handling in ``verify_signatures_async``, fetch-time in
+        ``_HybridTpuDispatch.result`` — keeping a second copy in lockstep is
+        how probe-ownership bugs breed)."""
+        return self.verify_signatures_async(
+            public_keys, digests, signatures
+        ).result()
 
     def _verify_cpu(self, public_keys, digests, signatures, n):
         estimated = n * self.cpu_per_sig_s
@@ -497,35 +572,105 @@ class HybridSignatureVerifier(SignatureVerifier):
         self._tls.padded = n  # host oracle: no padding lanes
         return out
 
-    def _verify_tpu(self, public_keys, digests, signatures, n):
-        estimated = self._tpu_time(n)
-        self._tls.padded = self.tpu.padded_batch(n)
-        started = time.monotonic()
-        out = self.tpu.verify_signatures(public_keys, digests, signatures)
-        sample = time.monotonic() - started
-        self._close_breaker()  # a successful probe re-opens the route
-        self._note_route("tpu", estimated, sample)
+    def _absorb_tpu_sample(self, sample: float, n: int) -> None:
+        """Fold one measured TPU dispatch into the two-parameter cost model.
+
+        The residual against the CURRENT model is split 50/50 between the
+        fixed and marginal components (ADVICE r5): attributing the FULL
+        residual to both in the same update — each computed against the
+        other's pre-update value — let one slow dispatch inflate the summed
+        model by ~double the residual and wrongly veto the rule-2 saturation
+        offload until the EMAs decayed.  With the split, the summed model
+        moves by exactly the residual; observations at varied batch sizes
+        still disambiguate fixed from marginal over time, and the fixed
+        component can still rise (a tunnel settling slower than its warmup
+        probe is not misattributed wholesale to per-signature cost).
+        """
+        if sample >= self.EMA_OUTLIER_S:
+            return
         with self._ema_lock:
-            if sample < self.EMA_OUTLIER_S:
-                # Co-adapt BOTH cost parameters toward the residual each
-                # leaves under the other's current estimate: the fixed
-                # component can rise as well as fall (a tunnel settling
-                # slower than its warmup probe must not get its whole rise
-                # misattributed to per-signature cost, which would wrongly
-                # veto the saturation offload), and observations at varied
-                # batch sizes disambiguate the split over time.
-                implied_fixed = max(0.0, sample - n * self.tpu_per_sig_s)
-                implied_marginal = max(
-                    0.0, (sample - self.tpu_dispatch_s) / n
-                )
-                self.tpu_dispatch_s = _update_ema(
-                    self.tpu_dispatch_s, implied_fixed, self.EMA_OUTLIER_S
-                )
-                self.tpu_per_sig_s = _update_ema(
-                    self.tpu_per_sig_s, implied_marginal, self.EMA_OUTLIER_S
-                )
-        self._tls.label = "hybrid-tpu"
-        return out
+            residual = sample - (self.tpu_dispatch_s + n * self.tpu_per_sig_s)
+            implied_fixed = max(0.0, self.tpu_dispatch_s + 0.5 * residual)
+            implied_marginal = max(
+                0.0, self.tpu_per_sig_s + 0.5 * residual / n
+            )
+            self.tpu_dispatch_s = _update_ema(
+                self.tpu_dispatch_s, implied_fixed, self.EMA_OUTLIER_S
+            )
+            self.tpu_per_sig_s = _update_ema(
+                self.tpu_per_sig_s, implied_marginal, self.EMA_OUTLIER_S
+            )
+
+class _HybridTpuDispatch:
+    """An in-flight TPU-routed batch of the hybrid verifier.
+
+    ``result()`` runs on the fetch stage's executor thread, so the breaker
+    bookkeeping, cost-model update, and the thread-local backend label all
+    land exactly where the sync path put them — the collector reads
+    ``backend_label``/``dispatch_padded`` right after ``result()`` in the
+    same thread.  A transport/timeout failure surfacing at fetch trips the
+    breaker and verifies THIS batch on the oracle: a backend dying
+    mid-pipeline loses zero futures."""
+
+    __slots__ = ("_hybrid", "_handle", "_args", "_n", "_estimated",
+                 "_padded", "_started", "_is_probe", "_gen")
+
+    def __init__(self, hybrid, handle, public_keys, digests, signatures,
+                 n, is_probe: bool = False, gen: int = 0) -> None:
+        self._hybrid = hybrid
+        self._handle = handle
+        self._args = (public_keys, digests, signatures)
+        self._n = n
+        self._estimated = hybrid._tpu_time(n)
+        self._padded = hybrid.tpu.padded_batch(n)
+        self._started = time.monotonic()
+        self._is_probe = is_probe
+        self._gen = gen
+
+    def result(self) -> List[bool]:
+        h = self._hybrid
+        try:
+            out = self._handle.result()
+        except h.BREAKER_EXCEPTIONS as exc:
+            if isinstance(exc, VerifierProtocolError):
+                if self._is_probe:
+                    h._clear_probe()
+                raise
+            h._trip_breaker(exc, owns_probe=self._is_probe)
+            if h.metrics is not None:
+                h.metrics.verifier_fallback_total.inc()
+            return h._verify_cpu(*self._args, self._n)
+        except BaseException:
+            if self._is_probe:
+                h._clear_probe()
+            raise
+        # Submit-to-fetch wall time: under pipelining this is the batch's
+        # actual turnaround (what the router's model predicts), queueing
+        # included; the EMA's outlier gate still drops compile stalls.
+        sample = time.monotonic() - self._started
+        if not h._close_breaker(expected_gen=self._gen) and self._is_probe:
+            # A newer trip owns the circuit: this probe's success is stale
+            # evidence — its only remaining obligation is releasing the
+            # exclusive probe slot it still holds.
+            h._clear_probe()
+        h._note_route("tpu", self._estimated, sample)
+        h._absorb_tpu_sample(sample, self._n)
+        h._tls.label = "hybrid-tpu"
+        h._tls.padded = self._padded
+        return list(out)
+
+    def abandon(self) -> None:
+        """Release per-dispatch state without fetching (the flush was
+        cancelled): if THIS dispatch owns the breaker's exclusive probe
+        flag it must not stay stuck — only ``result()`` would otherwise
+        clear it — and the inner handle may hold its own releasable state.
+        A non-probe dispatch touches nothing (clearing unconditionally
+        could release a concurrent probe's exclusivity)."""
+        if self._is_probe:
+            self._hybrid._clear_probe()
+        inner = getattr(self._handle, "abandon", None)
+        if inner is not None:
+            inner()
 
 
 async def aggregate_verify(
@@ -705,6 +850,35 @@ class ThresholdAggregateVerifier(BlockVerifier):
         )
 
 
+def _observe_orphan(fut) -> None:
+    """Retrieve an orphaned executor future's exception so a backend crash
+    after the awaiting flush was cancelled is logged, not swallowed into an
+    'exception was never retrieved' warning at shutdown."""
+    if fut.cancelled():
+        return
+    exc = fut.exception()
+    if exc is not None:
+        log.warning("orphaned verify dispatch failed after cancel: %r", exc)
+
+
+def _abandon_dispatch(fut) -> None:
+    """Dispose a submitted-but-never-fetched dispatch handle.
+
+    Handles that hold releasable state expose ``abandon()``; plain handles
+    (completed/deferred/JAX device arrays) need nothing.  A submit that
+    RAISED already cleaned up after itself (the hybrid clears its probe, the
+    remote client discards its connection)."""
+    if fut.cancelled() or fut.exception() is not None:
+        return
+    abandon = getattr(fut.result(), "abandon", None)
+    if abandon is None:
+        return
+    try:
+        abandon()
+    except Exception:  # noqa: BLE001 - best-effort cleanup on shutdown
+        log.exception("abandoning an in-flight verify dispatch failed")
+
+
 class BatchedSignatureVerifier(BlockVerifier):
     """Deadline/size-triggered batching collector in front of a SignatureVerifier.
 
@@ -729,12 +903,22 @@ class BatchedSignatureVerifier(BlockVerifier):
         max_delay_s: float = 0.005,
         metrics=None,
         aggregate: bool = False,
+        pipeline_depth: Optional[int] = None,
     ) -> None:
         self.committee = committee
         self.verifier = verifier or TpuSignatureVerifier()
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.metrics = metrics
+        # Staged dispatch window: several flushes may be in flight at once
+        # (pack N+1 while N computes and N-1's results ride back), bounded
+        # so a flooding peer cannot queue unbounded device work.  Depth
+        # adapts to the router's measured fixed dispatch cost unless pinned.
+        self.pipeline = VerifyPipeline(
+            depth=pipeline_depth,
+            metrics=metrics,
+            fixed_cost_fn=self._pipeline_fixed_cost,
+        )
         # Collector-level threshold aggregation (BASELINE #5's technique at
         # the place it actually bites): one flush window pools blocks from
         # EVERY peer connection, so the batch spans authors — exactly what
@@ -778,6 +962,14 @@ class BatchedSignatureVerifier(BlockVerifier):
     MIN_ADAPTIVE_DELAY_S = 0.0005
     EMA_OUTLIER_S = 5.0
 
+    def _pipeline_fixed_cost(self) -> float:
+        """Fixed dispatch cost estimate for the adaptive pipeline depth: the
+        hybrid router's measured fixed component when available, else the
+        collector's own dispatch-latency EMA (reads are unlocked snapshots —
+        depth adaptation tolerates a stale value)."""
+        fixed = getattr(self.verifier, "tpu_dispatch_s", 0.0)
+        return fixed if fixed > 0.0 else self._dispatch_ema_s
+
     def _effective_delay_s(self) -> float:
         """Collection window, adaptive in BOTH directions around the
         ``max_delay_s`` default:
@@ -811,31 +1003,92 @@ class BatchedSignatureVerifier(BlockVerifier):
     async def verify(self, block: StatementBlock) -> None:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        flush_now = False
+        window = None
         with self._lock:
             self._pending.append((block, future))
             if len(self._pending) >= self.max_batch:
-                flush_now = True
+                # Take the full window NOW (max_batch stays the dispatch
+                # bound) and open a fresh one immediately.
+                window = self._pending
+                self._pending = []
+                if self._flush_task is not None:
+                    self._flush_task.cancel()
+                    self._flush_task = None
             elif self._flush_task is None:
                 self._flush_task = loop.call_later(
                     self._effective_delay_s(),
                     lambda: spawn_logged(self._flush(), log, name="verify-flush"),
                 )
-        if flush_now:
-            await self._flush()
+        if window is not None:
+            # Flush as its own task instead of awaiting it: the PRIOR
+            # window's dispatch may still be in flight, and the staged
+            # pipeline (bounded depth) is what lets this window's pack
+            # overlap it.  The spawned task observes/attributes its own
+            # failures; this caller still awaits its block's future below.
+            spawn_logged(self._flush(window), log, name="verify-flush")
         ok = await future
         if not ok:
             raise VerificationError(
                 f"signature verification failed for {block.reference!r}"
             )
 
-    async def _flush(self) -> None:
-        with self._lock:
-            batch = self._pending
-            self._pending = []
-            if self._flush_task is not None:
-                self._flush_task.cancel()
-                self._flush_task = None
+    def _submit_dispatch(self, pks, digests, sigs):
+        """Device stage (executor thread): pack-to-wire + non-blocking
+        submission through the backend's async seam.  Returns the in-flight
+        handle; for host backends without a device queue the handle defers
+        the work to the fetch stage."""
+        timer = (
+            self.metrics.utilization_timer("verify:dispatch")
+            if self.metrics is not None
+            else contextlib.nullcontext()
+        )
+        with timer:
+            submit = getattr(self.verifier, "verify_signatures_async", None)
+            if submit is None:
+                # Duck-typed backend predating the async seam: defer the
+                # sync path to the fetch stage.
+                return DeferredDispatch(
+                    self.verifier.verify_signatures, pks, digests, sigs
+                )
+            return submit(pks, digests, sigs)
+
+    def _dispatch_and_fetch(self, pks, digests, sigs):
+        """Single-hop dispatch (simulation path): submit + fetch in one
+        executor call — the pre-pipeline per-dispatch shape."""
+        return self._fetch_dispatch(
+            self._submit_dispatch(pks, digests, sigs), len(sigs)
+        )
+
+    def _fetch_dispatch(self, handle, n):
+        """Fetch stage (executor thread): block until the verdicts are
+        ready.  The backend label AND the padded lane count must be read in
+        THIS thread, right after ``result()`` — the hybrid verifier records
+        them thread-locally at fetch, so reading after the await would race
+        with concurrent flushes that routed the other way."""
+        timer = (
+            self.metrics.utilization_timer("verify:dispatch")
+            if self.metrics is not None
+            else contextlib.nullcontext()
+        )
+        with timer:
+            out = handle.result()
+        label = getattr(
+            self.verifier, "backend_label", type(self.verifier).__name__
+        )
+        padded = getattr(self.verifier, "dispatch_padded", None)
+        if padded is None:
+            padder = getattr(self.verifier, "padded_batch", None)
+            padded = padder(n) if padder is not None else n
+        return out, label, padded
+
+    async def _flush(self, batch=None) -> None:
+        if batch is None:
+            with self._lock:
+                batch = self._pending
+                self._pending = []
+                if self._flush_task is not None:
+                    self._flush_task.cancel()
+                    self._flush_task = None
         if not batch:
             return
         blocks = [b for b, _ in batch]
@@ -844,38 +1097,93 @@ class BatchedSignatureVerifier(BlockVerifier):
         async def _direct(sub_blocks) -> List[bool]:
             if not sub_blocks:
                 return []
+            tracer = spans.active()
+            # -- pack stage (host, loop thread): key lookup + list building;
+            # the numpy pack-to-wire happens inside the submit below.
+            t_pack = tracer.now() if tracer is not None else 0.0
+            pack_started = time.monotonic()
             pks = [
                 self.committee.get_public_key(b.author()).bytes
                 for b in sub_blocks
             ]
             digests = [b.signed_digest() for b in sub_blocks]
             sigs = [b.signature for b in sub_blocks]
-
-            def _dispatch():
-                # The backend label AND the padded lane count must be
-                # captured in the same thread as the dispatch: reading them
-                # after the await would race with concurrent flushes that
-                # routed the other way (hybrid cpu/tpu split).
-                timer = (
-                    self.metrics.utilization_timer("verify:dispatch")
-                    if self.metrics is not None
-                    else contextlib.nullcontext()
+            self.pipeline.note_stage(
+                STAGE_PACK, time.monotonic() - pack_started
+            )
+            if tracer is not None:
+                for block in sub_blocks:
+                    tracer.record_span("verify_pack", block.reference, t_pack)
+            # -- bounded in-flight window: held from device submission
+            # through result fetch.  Other flush windows keep packing (and
+            # submitting, up to the depth) while this dispatch is in flight.
+            async with self.pipeline.slot():
+                t_dispatch = tracer.now() if tracer is not None else 0.0
+                t_fetch = t_dispatch
+                started = time.monotonic()
+                if is_simulated():
+                    # Inline (no executor hop) under the virtual-time
+                    # simulator: while a real thread works, the virtual
+                    # clock leaps timers, so ANY hop makes the sim's commit
+                    # schedule depend on host load (a starved 2-core CI box
+                    # can blow the whole virtual duration past one verify).
+                    # Synchronous on the loop thread the virtual clock is
+                    # frozen for the dispatch's duration — deterministic
+                    # regardless of machine weather.  Slots still bound
+                    # concurrency; sims measure determinism, not overlap.
+                    out, label, padded = self._dispatch_and_fetch(
+                        pks, digests, sigs
+                    )
+                    device_done = started
+                    # Keep the stage decomposition honest: the single hop
+                    # has no separate submit, so device is an explicit zero
+                    # (not a missing sample) and fetch carries the whole
+                    # dispatch.
+                    self.pipeline.note_stage(STAGE_DEVICE, 0.0)
+                else:
+                    submit_fut = loop.run_in_executor(
+                        None, self._submit_dispatch, pks, digests, sigs
+                    )
+                    try:
+                        handle = await asyncio.shield(submit_fut)
+                    except asyncio.CancelledError:
+                        # Flush task cancelled mid-submit (node shutdown):
+                        # the shielded executor job still runs and its
+                        # handle may hold per-dispatch backend state (a
+                        # pooled service connection, the breaker's exclusive
+                        # probe flag) that only the fetch normally releases
+                        # — dispose it the moment it lands.
+                        submit_fut.add_done_callback(_abandon_dispatch)
+                        raise
+                    device_done = time.monotonic()
+                    self.pipeline.note_stage(
+                        STAGE_DEVICE, device_done - started
+                    )
+                    if tracer is not None:
+                        t_fetch = tracer.now()
+                        for block in sub_blocks:
+                            tracer.record_span(
+                                "verify_device", block.reference, t_dispatch,
+                                t1=t_fetch,
+                            )
+                    # The fetch hop is shielded for the same reason the
+                    # submit hop is: an unshielded cancel can cancel a
+                    # QUEUED executor job before it starts, and then nothing
+                    # ever consumes the handle (pooled connection, probe
+                    # flag).  Shielded, the job always runs; result() does
+                    # its own cleanup, so cancellation here needs only to
+                    # observe the orphaned outcome.
+                    fetch_fut = loop.run_in_executor(
+                        None, self._fetch_dispatch, handle, len(sigs)
+                    )
+                    try:
+                        out, label, padded = await asyncio.shield(fetch_fut)
+                    except asyncio.CancelledError:
+                        fetch_fut.add_done_callback(_observe_orphan)
+                        raise
+                self.pipeline.note_stage(
+                    STAGE_FETCH, time.monotonic() - device_done
                 )
-                with timer:
-                    out = self.verifier.verify_signatures(pks, digests, sigs)
-                label = getattr(
-                    self.verifier, "backend_label", type(self.verifier).__name__
-                )
-                padded = getattr(self.verifier, "dispatch_padded", None)
-                if padded is None:
-                    padder = getattr(self.verifier, "padded_batch", None)
-                    padded = padder(len(sigs)) if padder is not None else len(sigs)
-                return out, label, padded
-
-            tracer = spans.active()
-            t_dispatch = tracer.now() if tracer is not None else 0.0
-            started = time.monotonic()
-            out, label, padded = await loop.run_in_executor(None, _dispatch)
             # The window EMA shares self._lock with the pending queue: the
             # read-modify-write must not interleave with _effective_delay_s
             # readers scheduling a flush from another flush's critical
@@ -887,9 +1195,13 @@ class BatchedSignatureVerifier(BlockVerifier):
                     self.EMA_OUTLIER_S,
                 )
             if tracer is not None:
+                t1 = tracer.now()
                 for block in sub_blocks:
                     tracer.record_span(
-                        "verify_dispatch", block.reference, t_dispatch
+                        "verify_fetch", block.reference, t_fetch, t1=t1
+                    )
+                    tracer.record_span(
+                        "verify_dispatch", block.reference, t_dispatch, t1=t1
                     )
             # Backend counters measure ACTUAL dispatches: counted here, per
             # dispatch, so aggregate-skipped blocks never inflate them.
@@ -932,6 +1244,19 @@ class BatchedSignatureVerifier(BlockVerifier):
             else:
                 _account(0, len(blocks))
                 results = await _direct(blocks)
+        except asyncio.CancelledError:
+            # Flush task cancelled mid-dispatch (node teardown — the timer
+            # handle's cancel() can't interrupt a running flush): the
+            # window's futures must still resolve or verify() callers that
+            # outlive this task park on `await future` forever.  Cancelling
+            # them marks the infra outcome (never a verdict) and the
+            # abandon/orphan callbacks above already released the backend
+            # state.
+            for _, future in batch:
+                self._deferred.discard(id(future))
+                if not future.done():
+                    future.cancel()
+            raise
         except Exception as exc:
             # A JAX runtime/compile failure must not strand the awaiting
             # connection tasks forever — fail every future in the batch.

@@ -279,6 +279,21 @@ class Core:
         )
         if self.options.fsync:
             self.wal_writer.sync()
+        elif self.wal_writer.pending():
+            # Durability floor for OWN proposals (ADVICE r5): the async
+            # append queue parks acknowledged entries in process memory, so
+            # without this drain a plain process crash (OOM/SIGKILL) after
+            # broadcast could lose the proposal and let the restarted node
+            # equivocate at the same round.  flush() lands the bytes in the
+            # page cache (the reference's synchronous-writev posture) BEFORE
+            # the caller signals new_block_ready to the dissemination
+            # streams; only OS/power failure retains a loss window, same as
+            # the reference.  No fsync: that stays the syncer thread's job.
+            # Cost: this blocks the owner until the drain thread lands the
+            # queue — the pending() gate makes it free when already caught
+            # up, and under backlog it repays, once per round, the same
+            # bytes synchronous mode would have paid inline per append.
+            self.wal_writer.flush()
         log.debug(
             "proposed block round=%d includes=%d statements=%d",
             block.round(),

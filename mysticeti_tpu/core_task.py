@@ -26,9 +26,19 @@ CORE_QUEUE_SIZE = 32
 
 
 class CoreTaskDispatcher:
-    # Consecutive command failures (with or without a live caller) after
-    # which the owner halts: a run this long is a persistent fail-stop
-    # condition, not caller churn.
+    # Consecutive COUNTED command failures after which the owner halts —
+    # and only when the run spans MORE THAN ONE command type.  A failure
+    # counts when no live caller received the exception (ADVICE r5: a
+    # client retry-looping one failing command gets its exception back
+    # every time — caller churn, not state corruption) OR when the command
+    # is INTERNAL (cleanup, get_missing, force_new_block: driven by the
+    # node's own periodic tasks, which a remote client cannot make fail —
+    # under a poisoned store they supply the halt's second command type
+    # within seconds even though their callers are alive and observing).
+    # The distinct-type requirement covers the churn the observed split
+    # alone cannot: a retry loop whose awaits are CANCELLED (e.g. wait_for
+    # timeouts) also reads as unobserved, but it hammers one command;
+    # genuine corruption poisons every mutation type.
     MAX_CONSECUTIVE_FAILURES = 16
 
     def __init__(self, syncer: Syncer, metrics=None,
@@ -73,9 +83,10 @@ class CoreTaskDispatcher:
         # (core.rs/core_thread) — scrapeable as utilization_timer{proc=...}.
         timers = self.metrics.utilization_timer if self.metrics else None
         consecutive_failures = 0
+        failed_kinds: Set[str] = set()
         dequeued = self.metrics.core_lock_dequeued if self.metrics else None
         while True:
-            command, args, reply = await self._queue.get()
+            command, args, reply, internal = await self._queue.get()
             if dequeued is not None:
                 dequeued.inc()
             try:
@@ -86,22 +97,36 @@ class CoreTaskDispatcher:
                 else:
                     result = command(*args)
                 consecutive_failures = 0
+                failed_kinds.clear()
                 if reply is not None and not reply.done():
                     reply.set_result(result)
             except Exception as e:  # propagate to the caller, keep the loop alive
-                consecutive_failures += 1
-                if reply is not None and not reply.done():
+                observed = reply is not None and not reply.done()
+                if observed:
                     reply.set_exception(e)
-                else:
-                    # Caller gone (connection task cancelled mid-await): the
-                    # owner loop must survive — dying here would wedge every
-                    # future consensus command fleet-wide, turning one
-                    # connection teardown into a total liveness failure.
-                    log.exception(
-                        "core command %s failed with no live caller",
-                        getattr(command, "__name__", command),
-                    )
-                if consecutive_failures >= self.MAX_CONSECUTIVE_FAILURES:
+                if observed and not internal:
+                    # A live caller received (and handles) the exception:
+                    # observed EXTERNAL failures are caller churn, not
+                    # corruption — they never count toward the fail-stop
+                    # halt.  Internal commands count regardless: a remote
+                    # client cannot drive them, so their failures are
+                    # trustworthy corruption evidence.
+                    continue
+                # Unobserved (caller cancelled mid-await) or internal: the
+                # owner loop must survive a short run — dying on one would
+                # wedge every future consensus command fleet-wide, turning
+                # one connection teardown into a total liveness failure.
+                consecutive_failures += 1
+                failed_kinds.add(getattr(command, "__name__", repr(command)))
+                log.exception(
+                    "core command %s failed (%s)",
+                    getattr(command, "__name__", command),
+                    "internal" if internal else "no live caller",
+                )
+                if (
+                    consecutive_failures >= self.MAX_CONSECUTIVE_FAILURES
+                    and len(failed_kinds) > 1
+                ):
                     # EVERY recent command failed: that is not a transient
                     # (a cancelled caller, one malformed batch) but a
                     # persistent fail-stop condition — WAL/state corruption,
@@ -115,11 +140,11 @@ class CoreTaskDispatcher:
                     )
                     raise
 
-    async def _call(self, fn, *args):
+    async def _call(self, fn, *args, internal: bool = False):
         reply: asyncio.Future = asyncio.get_running_loop().create_future()
         if self.metrics is not None:
             self.metrics.core_lock_enqueued.inc()
-        await self._queue.put((fn, args, reply))
+        await self._queue.put((fn, args, reply, internal))
         return await reply
 
     # -- commands (core_thread/spawned.rs:26-46) --
@@ -132,14 +157,20 @@ class CoreTaskDispatcher:
     async def force_new_block(
         self, round_: RoundNumber, connected: AuthoritySet
     ) -> bool:
-        return await self._call(self.syncer.force_new_block, round_, connected)
+        # internal: driven by the leader-timeout task, not a remote peer.
+        return await self._call(
+            self.syncer.force_new_block, round_, connected, internal=True
+        )
 
     async def cleanup(self) -> None:
-        return await self._call(self.syncer.core.cleanup)
+        # internal: driven by the syncer's periodic task.
+        return await self._call(self.syncer.core.cleanup, internal=True)
 
     async def get_missing(self) -> List[Set[BlockReference]]:
+        # internal: driven by the synchronizer's periodic task.
         return await self._call(
-            lambda: [set(s) for s in self.syncer.core.block_manager.missing_blocks()]
+            lambda: [set(s) for s in self.syncer.core.block_manager.missing_blocks()],
+            internal=True,
         )
 
     async def processed(

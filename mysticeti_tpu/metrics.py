@@ -272,6 +272,26 @@ class Metrics:
             "in-flight verify requests per service client connection",
             labels=("connection",),
         )
+        # Staged dispatch pipeline (verify_pipeline.py): the collector may
+        # hold several dispatches in flight; these series say how full the
+        # window runs and where each dispatch's time goes.
+        self.verify_pipeline_inflight = gauge(
+            "verify_pipeline_inflight",
+            "signature dispatches currently in flight through the staged "
+            "verify pipeline (bounded by verify_pipeline_depth)",
+        )
+        self.verify_pipeline_depth = gauge(
+            "verify_pipeline_depth",
+            "current bounded in-flight window of the verify pipeline "
+            "(occupancy = verify_pipeline_inflight / verify_pipeline_depth)",
+        )
+        self.verify_pipeline_stage_seconds = histogram(
+            "verify_pipeline_stage_seconds",
+            "per-dispatch time in each verify pipeline stage",
+            labels=("stage",),
+            buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 5.0],
+        )
         self.verifier_fallback_total = counter(
             "verifier_fallback_total",
             "signature batches degraded to the CPU oracle because the "

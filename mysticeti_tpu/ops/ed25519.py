@@ -789,6 +789,64 @@ def dispatch_indexed_chunks(blob: np.ndarray, table: "KeyTable"):
     return handles
 
 
+class VerifyDispatch:
+    """Future-like handle over one batch's in-flight bucket dispatches.
+
+    The explicit seam of the staged verify pipeline: ``dispatch_batch*``
+    packs on the host (numpy) and submits every bucket chunk through JAX's
+    async dispatch, returning immediately; ``result()`` forces everything
+    with ONE combined device sync (``fetch_handles``) only at consumption.
+    Between the two, the caller can pack and submit further batches — the
+    device streams chunk after chunk instead of idling a full round-trip
+    per dispatch.
+
+    ``patches`` carries straggler sub-dispatches (unknown-key items routed
+    through the generic kernel): ``(row indices, handle)`` pairs whose
+    results overwrite those rows at fetch time.
+    """
+
+    __slots__ = ("_entries", "_patches")
+
+    def __init__(self, entries, patches=()) -> None:
+        self._entries = list(entries)
+        self._patches = tuple(patches)
+
+    def result(self) -> np.ndarray:
+        out = fetch_handles(self._entries)
+        for rows, handle in self._patches:
+            out[rows] = handle.result()
+        return out
+
+
+def dispatch_batch_table(
+    table: "KeyTable",
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> VerifyDispatch:
+    """Non-blocking committee-indexed dispatch: pack (host) + submit every
+    bucket chunk asynchronously; the returned handle fetches on demand.
+    Items whose pk is not in the table ride a generic-path patch."""
+    n = len(signatures)
+    if n == 0:
+        return VerifyDispatch([])
+    if not all(len(m) == 32 for m in messages):
+        return dispatch_batch(public_keys, messages, signatures)
+    idx = table.indices_for(public_keys)
+    known = idx >= 0
+    blob = pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
+    handles = dispatch_indexed_chunks(blob, table)
+    if known.all():
+        return VerifyDispatch(handles)
+    stragglers = np.flatnonzero(~known)
+    generic = dispatch_batch(
+        [public_keys[i] for i in stragglers],
+        [messages[i] for i in stragglers],
+        [signatures[i] for i in stragglers],
+    )
+    return VerifyDispatch(handles, [(stragglers, generic)])
+
+
 def verify_batch_table(
     table: "KeyTable",
     public_keys: Sequence[bytes],
@@ -798,26 +856,9 @@ def verify_batch_table(
     """verify_batch against a known signer set: per-sig transfer drops to 26
     words.  Items whose pk is not in the table fall back to the generic path
     (correctness is identical; only the wire format differs)."""
-    n = len(signatures)
-    if n == 0:
-        return np.zeros(0, bool)
-    if not all(len(m) == 32 for m in messages):
-        return verify_batch(public_keys, messages, signatures)
-    idx = table.indices_for(public_keys)
-    known = idx >= 0
-    blob = pack_blob_indexed(idx, messages, signatures, num_keys=len(table))
-    handles = dispatch_indexed_chunks(blob, table)
-    if known.all():
-        return fetch_handles(handles)
-    stragglers = np.flatnonzero(~known)
-    generic = verify_batch(
-        [public_keys[i] for i in stragglers],
-        [messages[i] for i in stragglers],
-        [signatures[i] for i in stragglers],
-    )
-    out = fetch_handles(handles)
-    out[stragglers] = generic
-    return out
+    return dispatch_batch_table(
+        table, public_keys, messages, signatures
+    ).result()
 
 
 # ---------------------------------------------------------------------------
@@ -995,6 +1036,40 @@ def fetch_handles(handles) -> np.ndarray:
     return out
 
 
+def dispatch_batch(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> VerifyDispatch:
+    """Non-blocking batched dispatch: the pack stage runs here on the host
+    (pure numpy for the fused path; the per-item SHA-512 loop otherwise),
+    every bucket chunk is submitted through JAX's async dispatch, and the
+    returned handle fetches on demand — ``block_until_ready`` semantics only
+    at consumption."""
+    n = len(signatures)
+    if n == 0:
+        return VerifyDispatch([])
+    fused = all(len(m) == 32 for m in messages)
+    if fused:
+        blob = pack_blob(public_keys, messages, signatures)
+        # Dispatch every chunk asynchronously (one transfer each); the
+        # handle forces all results with a single combined fetch, so device
+        # work and transfers overlap across chunks and only one round-trip
+        # is paid at the end.
+        return VerifyDispatch(dispatch_blob_chunks(blob))
+    arrays = pack_batch(public_keys, messages, signatures)
+    handles = [
+        (
+            count,
+            verify_kernel(
+                *[jnp.asarray(_pad_to(x[start : start + count], b)) for x in arrays]
+            ),
+        )
+        for start, count, b in iter_buckets(n)
+    ]
+    return VerifyDispatch(handles)
+
+
 def verify_batch(
     public_keys: Sequence[bytes],
     messages: Sequence[bytes],
@@ -1006,27 +1081,7 @@ def verify_batch(
     packed with pure numpy and everything else happens on device.  Other
     message lengths fall back to the host-hash packing path.
     """
-    n = len(signatures)
-    if n == 0:
-        return np.zeros(0, bool)
-    fused = all(len(m) == 32 for m in messages)
-    if fused:
-        blob = pack_blob(public_keys, messages, signatures)
-        # Dispatch every chunk asynchronously (one transfer each), force all
-        # results with a single combined fetch: device work and transfers
-        # overlap across chunks and only one round-trip is paid at the end.
-        return fetch_handles(dispatch_blob_chunks(blob))
-    arrays = pack_batch(public_keys, messages, signatures)
-    handles = [
-        (
-            count,
-            verify_kernel(
-                *[jnp.asarray(_pad_to(x[start : start + count], b)) for x in arrays]
-            ),
-        )
-        for start, count, b in iter_buckets(n)
-    ]
-    return fetch_handles(handles)
+    return dispatch_batch(public_keys, messages, signatures).result()
 
 
 def _pad_to(x: np.ndarray, size: int) -> np.ndarray:

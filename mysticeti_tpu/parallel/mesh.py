@@ -176,20 +176,19 @@ def _cached_indexed_kernel(mesh: Mesh):
     return _KERNEL_CACHE[key]
 
 
-def sharded_verify_batch_indexed(
+def dispatch_sharded_indexed(
     mesh: Mesh,
     table: "E.KeyTable",
     public_keys: Sequence[bytes],
     messages: Sequence[bytes],
     signatures: Sequence[bytes],
-) -> Tuple[np.ndarray, int]:
-    """Committee-indexed fused verification sharded over the mesh: minimum
-    wire format (26 words/sig) AND batch-axis parallelism.  Unknown-key items
-    route through the generic sharded path so results never depend on table
-    contents."""
+) -> "E.VerifyDispatch":
+    """Non-blocking sharded committee-indexed dispatch: pack on the host,
+    submit every bucket chunk through the mesh kernel, return a handle that
+    fetches on demand (the staged pipeline's device stage)."""
     n = len(signatures)
     if n == 0:
-        return np.zeros(0, bool), 0
+        return E.VerifyDispatch([])
     idx = table.indices_for(public_keys)
     known = idx >= 0
     kernel = _cached_indexed_kernel(mesh)
@@ -208,37 +207,50 @@ def sharded_verify_batch_indexed(
         )
         for start, count, b in E.iter_buckets(n)
     ]
-    out = E.fetch_handles(handles)
-    total = int(out.sum())
+    patches = []
     if not known.all():
         stragglers = np.flatnonzero(~known)
-        ok_s, _ = sharded_verify_batch_fused(
-            mesh,
-            [public_keys[i] for i in stragglers],
-            [messages[i] for i in stragglers],
-            [signatures[i] for i in stragglers],
+        patches.append(
+            (
+                stragglers,
+                dispatch_sharded_fused(
+                    mesh,
+                    [public_keys[i] for i in stragglers],
+                    [messages[i] for i in stragglers],
+                    [signatures[i] for i in stragglers],
+                ),
+            )
         )
-        out[stragglers] = ok_s
-        total += int(ok_s.sum())
-    return out, total
+    return E.VerifyDispatch(handles, patches)
 
 
-def sharded_verify_batch_fused(
+def sharded_verify_batch_indexed(
     mesh: Mesh,
+    table: "E.KeyTable",
     public_keys: Sequence[bytes],
     messages: Sequence[bytes],
     signatures: Sequence[bytes],
 ) -> Tuple[np.ndarray, int]:
-    """Fused raw-bytes verification sharded over the mesh batch axis.
+    """Committee-indexed fused verification sharded over the mesh: minimum
+    wire format (26 words/sig) AND batch-axis parallelism.  Unknown-key items
+    route through the generic sharded path so results never depend on table
+    contents."""
+    out = dispatch_sharded_indexed(
+        mesh, table, public_keys, messages, signatures
+    ).result()
+    return out, int(out.sum())
 
-    Uses the fixed bucket shapes of :mod:`..ops.ed25519` (all divisible by
-    any power-of-two mesh up to 256 devices) so XLA compiles at most
-    len(BUCKETS) shard programs per mesh.  Returns (per-item bool, global
-    valid count via ICI psum).
-    """
+
+def dispatch_sharded_fused(
+    mesh: Mesh,
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> "E.VerifyDispatch":
+    """Non-blocking sharded fused dispatch (raw-bytes wire format)."""
     n = len(signatures)
     if n == 0:
-        return np.zeros(0, bool), 0
+        return E.VerifyDispatch([])
     kernel = _cached_fused_kernel(mesh)
     msg_words, s_words, host_ok = E.pack_bytes(public_keys, messages, signatures)
     # Dispatch every chunk asynchronously, force once at the end — same
@@ -256,5 +268,23 @@ def sharded_verify_batch_fused(
         )
         for start, count, b in E.iter_buckets(n)
     ]
-    out = E.fetch_handles(handles)
+    return E.VerifyDispatch(handles)
+
+
+def sharded_verify_batch_fused(
+    mesh: Mesh,
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> Tuple[np.ndarray, int]:
+    """Fused raw-bytes verification sharded over the mesh batch axis.
+
+    Uses the fixed bucket shapes of :mod:`..ops.ed25519` (all divisible by
+    any power-of-two mesh up to 256 devices) so XLA compiles at most
+    len(BUCKETS) shard programs per mesh.  Returns (per-item bool, global
+    valid count via ICI psum).
+    """
+    out = dispatch_sharded_fused(
+        mesh, public_keys, messages, signatures
+    ).result()
     return out, int(out.sum())

@@ -47,11 +47,23 @@ def spawn(coro: Coroutine) -> asyncio.Task:
     return asyncio.get_running_loop().create_task(coro)
 
 
+def is_simulated() -> bool:
+    """True when running under the deterministic virtual-time loop.  Code on
+    real-thread boundaries (executor dispatch) uses this to minimize loop
+    round-trips: while a real thread works, the virtual clock leaps timers,
+    so every extra hop skews a sim's virtual/real time ratio."""
+    try:
+        return isinstance(asyncio.get_running_loop(), DeterministicLoop)
+    except RuntimeError:
+        return False
+
+
 __all__ = [
     "sleep",
     "now",
     "timestamp_utc",
     "spawn",
+    "is_simulated",
     "DeterministicLoop",
     "SimulatedClock",
 ]

@@ -10,6 +10,9 @@ the pipeline as a sequence of structured spans keyed by
                    dispatch, through the pluggable verifier)
     verify_dispatch block_validator: one actual accelerator/CPU dispatch
                    (per block of the dispatched sub-batch)
+    verify_pack    / verify_device / verify_fetch — the staged pipeline's
+                   sub-stages of that dispatch (host packing, non-blocking
+                   device submission, result fetch; verify_pipeline.py)
     dag_add        net_sync -> core: core-task queue wait + BlockManager
                    insertion (includes time parked on missing parents)
     proposal_wait  core -> commit_observer: accepted into the DAG until
@@ -52,6 +55,12 @@ STAGES = (
     "receive",
     "verify",
     "verify_dispatch",
+    # Staged dispatch pipeline sub-stages (verify_pipeline.py): host packing,
+    # non-blocking device submission, and the result fetch — per dispatched
+    # block, so a trace shows WHERE a dispatch's round-trip went.
+    "verify_pack",
+    "verify_device",
+    "verify_fetch",
     "dag_add",
     "proposal_wait",
     "commit",

@@ -81,8 +81,14 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
     # skip their dispatch: a self-relieving valve exactly where verification
     # binds, at zero steady-state cost.
     window_ms = float(os.environ.get("MYSTICETI_VERIFY_WINDOW_MS", "5"))
+    # Staged dispatch pipeline depth (verify_pipeline.py): default adapts to
+    # the router's measured fixed dispatch cost; pin it for experiments.
+    depth_env = os.environ.get("MYSTICETI_VERIFY_PIPELINE_DEPTH")
     collector_opts = dict(
-        metrics=metrics, aggregate=aggregate, max_delay_s=window_ms / 1e3
+        metrics=metrics,
+        aggregate=aggregate,
+        max_delay_s=window_ms / 1e3,
+        pipeline_depth=int(depth_env) if depth_env else None,
     )
     if kind in ("tpu", "tpu-only"):
         committee_keys = committee.public_key_bytes()

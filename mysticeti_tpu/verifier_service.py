@@ -55,9 +55,15 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
-from .block_validator import SignatureVerifier, VerifierProtocolError
+from .block_validator import (
+    CpuSignatureVerifier,
+    SignatureVerifier,
+    VerifierProtocolError,
+)
 from .network import jittered_backoff
+from .verify_pipeline import CompletedDispatch, DeferredDispatch
 from .tracing import logger
+from .utils.tasks import spawn_logged
 
 log = logger(__name__)
 
@@ -83,6 +89,19 @@ def _frame(type_: int, payload: bytes) -> bytes:
     return struct.pack("<IB", len(payload), type_) + payload
 
 
+def _abandoned_reply(fut: asyncio.Future, cleanup) -> None:
+    """Completion hook for a dispatch whose connection died before its reply
+    could be written: retrieve the exception (so asyncio never logs it as
+    never-retrieved at GC) and only then release the service gauges."""
+    if not fut.cancelled() and fut.exception() is not None:
+        log.error(
+            "verifier service dispatch failed after client disconnect",
+            exc_info=fut.exception(),
+        )
+    if cleanup is not None:
+        cleanup()
+
+
 # ---------------------------------------------------------------------------
 # Server
 
@@ -90,10 +109,17 @@ def _frame(type_: int, payload: bytes) -> bytes:
 class VerifierServer:
     """One accelerator runtime serving every validator on the host."""
 
+    # Per-connection staged request window: the reader decodes request N+1
+    # while N computes in the pool; replies are written strictly in request
+    # order by a dedicated writer task.  The bound backpressures a client
+    # pipelining faster than the backend drains.
+    PIPELINE_DEPTH = 8
+
     def __init__(self, socket_path: str, committee_keys: Optional[Sequence[bytes]] = None,
                  backend=None, metrics=None) -> None:
         self.socket_path = socket_path
         self._backend = backend
+        self._owns_backend = backend is None
         self._keys: Optional[List[bytes]] = (
             list(committee_keys) if committee_keys else None
         )
@@ -122,17 +148,28 @@ class VerifierServer:
         # the losers just block here until the first one finishes (which is
         # exactly the contract their HELLO wants anyway).
         with self._warm_lock:
-            if self._keys is None:
-                self._keys = keys
-            elif keys and self._keys != keys:
-                raise ValueError(
-                    "committee mismatch: this verifier service was warmed for "
-                    "a different key set"
-                )
+            if keys:
+                if self._keys is None:
+                    # First NON-EMPTY committee establishes the service key
+                    # set (ADVICE r5: an early zero-key HELLO from a RAW-only
+                    # client must not pin the committee to [] and poison
+                    # every later client with a permanent mismatch).  If a
+                    # keyless backend was already built for such a client,
+                    # rebuild it around the real committee's key table.
+                    self._keys = keys
+                    if self._backend is not None and self._owns_backend:
+                        self._backend = None
+                        self._warmed.clear()
+                elif self._keys != keys:
+                    raise ValueError(
+                        "committee mismatch: this verifier service was warmed "
+                        "for a different key set"
+                    )
             if self._backend is None:
                 from .block_validator import TpuSignatureVerifier
 
                 self._backend = TpuSignatureVerifier(committee_keys=self._keys)
+                self._owns_backend = True
             if not self._warmed.is_set():
                 self._backend.warmup()
                 self._calibrate()
@@ -180,85 +217,266 @@ class VerifierServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        # Staged per-connection request pipeline: the reader decodes and
+        # submits request N+1 while request N computes in the pool; a
+        # dedicated writer task emits replies strictly in request order (the
+        # protocol contract clients rely on), so the service is no longer a
+        # stop-and-wait RPC for a client that pipelines its frames.
         loop = asyncio.get_running_loop()
         self._writers.add(writer)
         conn_label = f"c{next(self._conn_ids)}"
+        replies: asyncio.Queue = asyncio.Queue(maxsize=self.PIPELINE_DEPTH)
+        reply_task = spawn_logged(
+            self._reply_writer(replies, writer), log, name="verifier-replies"
+        )
+
+        def _accounted():
+            metrics = self.metrics
+            if metrics is None:
+                return None
+            # Depth = requests handed to the pool and not yet answered
+            # (queued behind the 16 workers or mid-dispatch); inflight
+            # splits it per client connection so one flooding validator is
+            # attributable.  Decremented by the writer once the reply is
+            # built (cleanup runs even when the dispatch raised).
+            metrics.verifier_service_queue_depth.inc()
+            metrics.verifier_service_inflight.labels(conn_label).inc()
+
+            def _done():
+                metrics.verifier_service_queue_depth.dec()
+                metrics.verifier_service_inflight.labels(conn_label).dec()
+
+            return _done
+
+        # A pipelined client may send VERIFY frames behind a HELLO without
+        # waiting for HELLO_OK; pool threads run jobs in any order, so a
+        # verify must not EXECUTE before the HELLO that establishes the
+        # committee finished (it would see no keys and report every slot
+        # invalid).  Replies stay ordered by the queue; execution is gated
+        # on the connection's last unresolved HELLO only.
+        last_hello: Optional[asyncio.Future] = None
+
+        async def _after_hello(gate, type_, req_id, n, body):
+            try:
+                hello_frame = await asyncio.shield(gate)
+            except Exception:  # noqa: BLE001 - HELLO's own reply carries it
+                hello_frame = None
+            if hello_frame is None or hello_frame[4] == T_ERR:
+                # The HELLO was rejected (committee mismatch) or crashed:
+                # the connection is being severed and this reply would be
+                # discarded in drain mode — do NOT burn a backend dispatch
+                # for it (a reconnect-looping misconfigured client would
+                # otherwise cost a device round-trip per queued frame).
+                return None
+            return await loop.run_in_executor(
+                self._pool, self._result_reply, type_, req_id, n, body
+            )
+
         try:
             while True:
                 try:
                     header = await reader.readexactly(5)
                 except asyncio.IncompleteReadError:
                     return
+                if reply_task.done():
+                    return  # writer died (client gone, backend crash)
                 length, type_ = struct.unpack("<IB", header)
                 payload = await reader.readexactly(length) if length else b""
                 if type_ == T_HELLO:
-                    (n_keys,) = struct.unpack_from("<H", payload)
+                    n_keys = (
+                        struct.unpack_from("<H", payload)[0]
+                        if length >= 2 else -1
+                    )
+                    if n_keys < 0 or length != 2 + 32 * n_keys:
+                        await replies.put(
+                            (_frame(T_ERR, b"malformed hello frame"),
+                             None, True)
+                        )
+                        return
                     keys = [
                         bytes(payload[2 + 32 * i: 2 + 32 * (i + 1)])
                         for i in range(n_keys)
                     ]
-                    try:
-                        await loop.run_in_executor(
-                            self._pool, self._ensure_backend, keys
-                        )
-                    except ValueError as exc:
-                        writer.write(_frame(T_ERR, str(exc).encode()))
-                        await writer.drain()
-                        return
-                    calibration = b""
-                    if self._calibration is not None:
-                        calibration = struct.pack("<dd", *self._calibration)
-                    writer.write(_frame(T_HELLO_OK, calibration))
-                    await writer.drain()
+                    # HELLO replies ride the same in-order queue as results:
+                    # a client that pipelines frames must never see HELLO_OK
+                    # overtake an earlier RESULT.
+                    fut = loop.run_in_executor(
+                        self._pool, self._hello_reply, keys
+                    )
+                    last_hello = fut
+                    await replies.put((fut, None, False))
                 elif type_ in (T_VERIFY, T_RAW):
+                    if length < 8:
+                        await replies.put(
+                            (_frame(T_ERR, b"malformed verify frame"),
+                             None, True)
+                        )
+                        return
                     req_id, n = struct.unpack_from("<II", payload)
                     body = payload[8:]
                     rec = _IDX_REC if type_ == T_VERIFY else _RAW_REC
                     if len(body) != n * rec:
-                        writer.write(_frame(T_ERR, b"malformed verify frame"))
-                        await writer.drain()
-                        return
-                    metrics = self.metrics
-                    if metrics is not None:
-                        # Depth = requests handed to the pool and not yet
-                        # answered (queued behind the 16 workers or mid-
-                        # dispatch); inflight splits it per client connection
-                        # so one flooding validator is attributable.
-                        metrics.verifier_service_queue_depth.inc()
-                        metrics.verifier_service_inflight.labels(
-                            conn_label
-                        ).inc()
-                    try:
-                        oks = await loop.run_in_executor(
-                            self._pool, self._verify_payload, type_, n, body
+                        await replies.put(
+                            (_frame(T_ERR, b"malformed verify frame"),
+                             None, True)
                         )
-                    finally:
-                        if metrics is not None:
-                            metrics.verifier_service_queue_depth.dec()
-                            metrics.verifier_service_inflight.labels(
-                                conn_label
-                            ).dec()
-                    writer.write(
-                        _frame(T_RESULT, struct.pack("<I", req_id) + bytes(oks))
-                    )
-                    await writer.drain()
+                        return
+                    if last_hello is not None and last_hello.done():
+                        rejected = last_hello.cancelled() or (
+                            last_hello.exception() is not None
+                            or last_hello.result()[4] == T_ERR
+                        )
+                        if rejected:
+                            # The writer is severing after the HELLO's ERR:
+                            # frames pipelined behind it must not burn
+                            # backend dispatches for replies that will be
+                            # discarded in drain mode.
+                            return
+                        last_hello = None  # accepted: no more gating needed
+                    done = _accounted()
+                    if last_hello is not None:
+                        # Awaited by the reply writer in order, which
+                        # observes its exception.  # lint: ignore[task-orphan]
+                        fut = asyncio.ensure_future(
+                            _after_hello(last_hello, type_, req_id, n, body)
+                        )
+                    else:
+                        fut = loop.run_in_executor(
+                            self._pool, self._result_reply,
+                            type_, req_id, n, body,
+                        )
+                    await replies.put((fut, done, False))
                 else:
-                    writer.write(_frame(T_ERR, b"unknown frame type"))
-                    await writer.drain()
+                    await replies.put(
+                        (_frame(T_ERR, b"unknown frame type"), None, True)
+                    )
                     return
         except (ConnectionResetError, BrokenPipeError, OSError):
             return
         finally:
-            if self.metrics is not None:
+            # Let the writer drain everything already submitted, then stop.
+            try:
+                replies.put_nowait(None)
+            except asyncio.QueueFull:
+                reply_task.cancel()
+            try:
+                await reply_task
+            except asyncio.CancelledError:
+                reply_task.cancel()
+            except Exception:  # noqa: BLE001 - writer logged its own failure
+                pass
+            # Anything left unqueued-for-write still owes its cleanup, but
+            # its dispatch may still be running on a pool thread: releasing
+            # the gauges now would show an idle service during real device
+            # work, and abandoning the future would leave its exception
+            # unretrieved.  Defer both to the dispatch's own completion.
+            abandoned = []
+            while True:
+                try:
+                    item = replies.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if item is None:
+                    continue
+                frame, cleanup, _close_after = item
+                if asyncio.isfuture(frame):
+                    abandoned.append((frame, cleanup))
+                elif cleanup is not None:
+                    cleanup()
+
+            def _remove_label() -> None:
                 # Labels are minted per connection from an unbounded counter;
                 # a reconnecting fleet would otherwise grow dead
                 # {connection="cN"} series in the registry forever.
-                try:
-                    self.metrics.verifier_service_inflight.remove(conn_label)
-                except KeyError:
-                    pass  # connection closed before its first verify
+                if self.metrics is not None:
+                    try:
+                        self.metrics.verifier_service_inflight.remove(
+                            conn_label
+                        )
+                    except KeyError:
+                        pass  # connection closed before its first verify
+
+            if abandoned:
+                # The label must outlive every deferred cleanup: a dec()
+                # after remove() would re-mint the dead series at -1 and
+                # leak it forever.  The LAST abandoned dispatch to complete
+                # removes it (done-callbacks run on the loop thread, so the
+                # countdown needs no lock).
+                remaining = {"n": len(abandoned)}
+
+                def _finish(fut, cleanup) -> None:
+                    _abandoned_reply(fut, cleanup)
+                    remaining["n"] -= 1
+                    if remaining["n"] == 0:
+                        _remove_label()
+
+                for fut, cleanup in abandoned:
+                    fut.add_done_callback(
+                        lambda f, cleanup=cleanup: _finish(f, cleanup)
+                    )
+            else:
+                _remove_label()
             self._writers.discard(writer)
             writer.close()
+
+    async def _reply_writer(self, replies: asyncio.Queue,
+                            writer: asyncio.StreamWriter) -> None:
+        """Emit queued replies in request order; ``None`` ends the stream.
+        Queue items are ``(frame_or_future, cleanup, close_after)``.  A
+        dispatch failure or a dead client socket flips to drain mode —
+        remaining cleanups still run (gauge hygiene) but nothing is written,
+        and the transport is closed so the reader unblocks."""
+        dead = False
+        while True:
+            item = await replies.get()
+            if item is None:
+                return
+            frame, cleanup, close_after = item
+            try:
+                if asyncio.isfuture(frame):
+                    try:
+                        frame = await frame
+                    except Exception:  # noqa: BLE001 - logged, conn severed
+                        log.exception("verifier service dispatch failed")
+                        frame = None
+                if dead or frame is None:
+                    dead = True
+                    writer.close()
+                    continue
+                if frame[4] == T_ERR:
+                    # Protocol errors sever the connection after the reply
+                    # (the pre-pipeline contract), wherever they were built.
+                    close_after = True
+                try:
+                    writer.write(frame)
+                    await writer.drain()
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    dead = True
+                    continue
+                if close_after:
+                    dead = True
+                    writer.close()
+            finally:
+                if cleanup is not None:
+                    cleanup()
+
+    def _hello_reply(self, keys: List[bytes]) -> bytes:
+        """Pool-side HELLO handling: warm (or adopt/upgrade) the backend and
+        frame the reply — HELLO_OK with the calibration, or ERR on a
+        committee mismatch (which also severs the connection client-side)."""
+        try:
+            self._ensure_backend(keys)
+        except ValueError as exc:
+            return _frame(T_ERR, str(exc).encode())
+        calibration = b""
+        if self._calibration is not None:
+            calibration = struct.pack("<dd", *self._calibration)
+        return _frame(T_HELLO_OK, calibration)
+
+    def _result_reply(self, type_: int, req_id: int, n: int,
+                      body: bytes) -> bytes:
+        oks = self._verify_payload(type_, n, body)
+        return _frame(T_RESULT, struct.pack("<I", req_id) + bytes(oks))
 
     def _verify_payload(self, type_: int, n: int, body: bytes) -> List[int]:
         backend = self._ensure_backend(self._keys or [])
@@ -354,6 +572,10 @@ class RemoteSignatureVerifier(SignatureVerifier):
     RETRY_BASE_BACKOFF_S = 0.05
     RETRY_MAX_BACKOFF_S = 1.0
 
+    # Bound on idle pooled connections for the async dispatch path; matches
+    # the deepest pipeline window the collector runs (verify_pipeline.py).
+    MAX_POOLED_CONNS = 4
+
     def __init__(self, socket_path: Optional[str] = None,
                  committee_keys: Optional[Sequence[bytes]] = None,
                  timeout_s: float = 300.0,
@@ -367,6 +589,15 @@ class RemoteSignatureVerifier(SignatureVerifier):
         self.max_attempts = max_attempts or self.MAX_ATTEMPTS
         self._retry_rng = random.Random(0x5E7C1E27)
         self._tls = threading.local()
+        # Connection pool for the STAGED path (verify_signatures_async): the
+        # submit and the fetch may run on different executor threads, so the
+        # in-flight handle carries its connection instead of leaning on the
+        # thread-local one.  _pool_size counts live pooled conns (idle +
+        # checked out) so the pool stays bounded across threads.
+        self._pool_conns: List[socket.socket] = []
+        self._pool_lock = threading.Lock()
+        self._pool_size = 0
+        self._async_req_ids = itertools.count(1)
         # (fixed_dispatch_s, per_sig_s) as measured by the SERVICE on its
         # own warmed backend (HELLO_OK payload); None until first connect.
         self.calibration: Optional[Tuple[float, float]] = None
@@ -464,17 +695,49 @@ class RemoteSignatureVerifier(SignatureVerifier):
         assert echoed == req_id, "verifier service response out of order"
         return payload[4:]
 
-    # -- SignatureVerifier surface --
+    # -- connection pool (async dispatch path) --
 
-    def warmup(self) -> None:
-        """Connect + HELLO: returns once the service's runtime is warm."""
-        self._conn()
+    def _pool_checkout(self) -> Optional[socket.socket]:
+        """An idle pooled connection, a fresh one, or None when the pool is
+        at its live-connection cap (idle + checked out) — the caller then
+        falls back to the sync path's thread-local connection."""
+        with self._pool_lock:
+            if self._pool_conns:
+                return self._pool_conns.pop()
+            if self._pool_size >= self.MAX_POOLED_CONNS:
+                return None
+            self._pool_size += 1
+        try:
+            return self._connect()
+        except BaseException:
+            with self._pool_lock:
+                self._pool_size -= 1
+            raise
 
-    def verify_signatures(self, public_keys, digests, signatures) -> List[bool]:
-        n = len(signatures)
-        if n == 0:
-            return []
-        self._tls.req_id = req_id = getattr(self._tls, "req_id", 0) + 1
+    def _pool_checkin(self, conn: socket.socket) -> None:
+        with self._pool_lock:
+            if len(self._pool_conns) < self.MAX_POOLED_CONNS:
+                self._pool_conns.append(conn)
+                return
+            self._pool_size -= 1
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _pool_discard(self, conn: socket.socket) -> None:
+        with self._pool_lock:
+            self._pool_size -= 1
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    # -- frame building --
+
+    def _build_frame(self, public_keys, digests, signatures, req_id, n):
+        """Pack one request frame, or None when the batch cannot ride the
+        service wire format (non-digest messages -> local oracle)."""
         indices = [self._index.get(pk) for pk in public_keys]
         if all(i is not None for i in indices) and all(
             len(d) == 32 for d in digests
@@ -483,27 +746,137 @@ class RemoteSignatureVerifier(SignatureVerifier):
                 struct.pack("<H", idx) + digest + sig
                 for idx, digest, sig in zip(indices, digests, signatures)
             )
-            frame = _frame(
-                T_VERIFY, struct.pack("<II", req_id, n) + body
-            )
-        else:
-            if not all(len(d) == 32 for d in digests):
-                # The service's fixed wire format carries 32-byte digests
-                # (every deployed call site signs blake2b-256); anything else
-                # is a test exotica — verify locally on the CPU oracle.
-                from .block_validator import CpuSignatureVerifier
+            return _frame(T_VERIFY, struct.pack("<II", req_id, n) + body)
+        if not all(len(d) == 32 for d in digests):
+            # The service's fixed wire format carries 32-byte digests
+            # (every deployed call site signs blake2b-256); anything else
+            # is a test exotica — verify locally on the CPU oracle.
+            return None
+        body = b"".join(
+            pk + digest + sig
+            for pk, digest, sig in zip(public_keys, digests, signatures)
+        )
+        return _frame(T_RAW, struct.pack("<II", req_id, n) + body)
 
-                return CpuSignatureVerifier().verify_signatures(
-                    public_keys, digests, signatures
-                )
-            body = b"".join(
-                pk + digest + sig
-                for pk, digest, sig in zip(public_keys, digests, signatures)
+    # -- SignatureVerifier surface --
+
+    def warmup(self) -> None:
+        """Connect + HELLO: returns once the service's runtime is warm."""
+        self._conn()
+
+    def verify_signatures_async(self, public_keys, digests, signatures):
+        """Staged dispatch: send the request now (on a pooled connection the
+        handle carries — submit and fetch may run on different executor
+        threads) and read the reply at ``result()``.  With the service's own
+        per-connection request pipeline, several of these overlap through
+        ONE warmed backend.  A send failure here falls back to the deferred
+        sync path, which owns the full reconnect-retry budget."""
+        n = len(signatures)
+        if n == 0:
+            return CompletedDispatch([])
+        req_id = next(self._async_req_ids)
+        frame = self._build_frame(
+            public_keys, digests, signatures, req_id, n
+        )
+        if frame is None:
+            return DeferredDispatch(
+                CpuSignatureVerifier().verify_signatures,
+                public_keys, digests, signatures,
             )
-            frame = _frame(T_RAW, struct.pack("<II", req_id, n) + body)
+        try:
+            conn = self._pool_checkout()
+        except VerifierProtocolError:
+            raise
+        except (ConnectionError, OSError, socket.timeout):
+            # No reconnect count here: the deferred sync fallback runs the
+            # full retry loop and accounts each torn-down attempt itself.
+            conn = None
+        if conn is None:
+            # Pool exhausted or unreachable: the sync path (thread-local
+            # connection, bounded retries) carries the batch at fetch time.
+            return DeferredDispatch(
+                self.verify_signatures, public_keys, digests, signatures
+            )
+        try:
+            conn.sendall(frame)
+        except (ConnectionError, OSError, socket.timeout):
+            self._pool_discard(conn)
+            if self.metrics is not None:
+                self.metrics.verifier_reconnect_total.inc()
+            return DeferredDispatch(
+                self.verify_signatures, public_keys, digests, signatures
+            )
+        return _RemoteDispatch(
+            self, conn, req_id, n, public_keys, digests, signatures
+        )
+
+    def verify_signatures(self, public_keys, digests, signatures) -> List[bool]:
+        n = len(signatures)
+        if n == 0:
+            return []
+        self._tls.req_id = req_id = getattr(self._tls, "req_id", 0) + 1
+        frame = self._build_frame(
+            public_keys, digests, signatures, req_id, n
+        )
+        if frame is None:
+            return CpuSignatureVerifier().verify_signatures(
+                public_keys, digests, signatures
+            )
         oks = self._roundtrip(frame, req_id)
         assert len(oks) == n
         return [bool(b) for b in oks]
+
+
+class _RemoteDispatch:
+    """An in-flight request to the verifier service.
+
+    ``result()`` reads the reply off the handle's own connection and returns
+    it to the pool.  A connection failure at fetch time is NOT fatal to the
+    batch: the connection is discarded and the whole request re-runs through
+    the sync path's bounded reconnect-retry budget (the service may have
+    restarted mid-flight; re-verifying is idempotent)."""
+
+    __slots__ = ("_client", "_conn", "_req_id", "_n", "_args")
+
+    def __init__(self, client, conn, req_id, n, public_keys, digests,
+                 signatures) -> None:
+        self._client = client
+        self._conn = conn
+        self._req_id = req_id
+        self._n = n
+        self._args = (public_keys, digests, signatures)
+
+    def result(self) -> List[bool]:
+        client = self._client
+        try:
+            type_, payload = client._read_frame(self._conn)
+        except VerifierProtocolError:
+            client._pool_discard(self._conn)
+            raise
+        except (ConnectionError, OSError, socket.timeout):
+            client._pool_discard(self._conn)
+            if client.metrics is not None:
+                client.metrics.verifier_reconnect_total.inc()
+            return client.verify_signatures(*self._args)
+        if type_ == T_ERR:
+            client._pool_discard(self._conn)
+            raise VerifierProtocolError(
+                f"verifier service error: {payload.decode(errors='replace')}"
+            )
+        client._pool_checkin(self._conn)
+        assert type_ == T_RESULT
+        (echoed,) = struct.unpack_from("<I", payload)
+        assert echoed == self._req_id, "verifier service response out of order"
+        oks = payload[4:]
+        assert len(oks) == self._n
+        return [bool(b) for b in oks]
+
+    def abandon(self) -> None:
+        """Release without fetching (the flush was cancelled): a connection
+        with an unread response must never return to the pool — the next
+        request on it would read a stale frame — so it is discarded, which
+        also keeps the pool's live-connection count honest."""
+        self._client._pool_discard(self._conn)
 
 
 def run_service(socket_path: str, committee_keys: Optional[Sequence[bytes]] = None,

@@ -77,10 +77,19 @@ class WalWriter:
     blocking write (measured 15% of wall time at saturated load).  Readers
     see in-flight entries through :meth:`inflight_get` (``walf`` wires the
     paired :class:`WalReader` to it), so read-after-write holds even before
-    the bytes reach the page cache.  Durability is unchanged: ``sync``
-    drains the queue then fsyncs, the 1 s syncer thread bounds the loss
-    window, and a crash truncates to a torn tail exactly as before (the
-    queue preserves append order; the drain thread writes sequentially).
+    the bytes reach the page cache.
+
+    Durability: WEAKER than synchronous appends for queued entries — until
+    the drain thread's pwrite lands, an acknowledged entry lives only in
+    process memory, so a plain process crash (OOM/SIGKILL) can lose it; the
+    reference's synchronous writev put entries in the page cache, where only
+    OS/power failure could.  Callers whose entries become EXTERNALLY VISIBLE
+    (an own proposal handed to dissemination) must ``flush()`` first —
+    ``Core.try_new_block`` does — restoring the page-cache floor exactly
+    where equivocation is at stake.  ``sync`` drains the queue then fsyncs,
+    the 1 s syncer thread bounds the fsync loss window, and a crash
+    truncates to a torn tail exactly as before (the queue preserves append
+    order; the drain thread writes sequentially).
     ``MYSTICETI_SYNC_WAL_WRITES=1`` restores fully synchronous appends.
     A/B at 24k offered tx/s on a single-core host: identical throughput,
     27% lower average commit latency with the writer thread (221 ms vs
@@ -198,6 +207,15 @@ class WalWriter:
             raise self._error
         with self._inflight_lock:
             return self._inflight.get(position)
+
+    def pending(self) -> bool:
+        """True while acknowledged appends are still queued in process
+        memory (cheap gate: callers skip the flush marker round-trip when
+        the drain thread is already caught up — the common case)."""
+        if not self._async:
+            return False
+        with self._inflight_lock:
+            return bool(self._inflight)
 
     def flush(self) -> None:
         """Block until every queued append has reached the file."""

@@ -298,3 +298,38 @@ def test_hybrid_never_offloads_to_a_degraded_backend():
     # Light load always stays local either way.
     h.tpu_per_sig_s = 0.0
     assert not h._route_to_tpu(3)
+
+
+def test_hybrid_ema_splits_residual_between_fixed_and_marginal():
+    """ADVICE r5: one slow dispatch used to feed its FULL residual to both
+    cost parameters in the same update (each against the other's pre-update
+    value), inflating the summed model by ~double the residual.  With the
+    50/50 split the summed model moves by exactly one EMA step of the
+    residual — a transient can no longer wrongly veto the saturation
+    offload."""
+    from mysticeti_tpu.block_validator import (
+        HybridSignatureVerifier,
+        SignatureVerifier,
+    )
+
+    class Stub(SignatureVerifier):
+        def verify_signatures(self, pks, digests, sigs):
+            return [True] * len(sigs)
+
+    h = HybridSignatureVerifier(tpu=Stub(), cpu=Stub())
+    h.tpu_dispatch_s = 0.1
+    h.tpu_per_sig_s = 0.0005
+    n = 100
+    before = h._tpu_time(n)
+    residual = 0.2
+    h._absorb_tpu_sample(before + residual, n)
+    after = h._tpu_time(n)
+    assert after > before  # the model does track the slow sample...
+    # ...but by ONE EMA step (alpha=0.2) of the residual, not two.
+    assert after - before == pytest.approx(0.2 * residual, rel=1e-6)
+    # Symmetric on the way down, and outliers never enter.
+    h._absorb_tpu_sample(h._tpu_time(n) - 0.1, n)
+    assert h._tpu_time(n) < after
+    frozen = (h.tpu_dispatch_s, h.tpu_per_sig_s)
+    h._absorb_tpu_sample(h.EMA_OUTLIER_S + 1.0, n)
+    assert (h.tpu_dispatch_s, h.tpu_per_sig_s) == frozen

@@ -342,14 +342,65 @@ def test_breaker_admits_exactly_one_probe():
     hybrid = HybridSignatureVerifier(tpu=tpu, cpu=StubCpuBackend(),
                                      threshold=1)
     hybrid._breaker_clock = lambda: clock["t"]
+
+    def blocks() -> bool:
+        # NB: admission is a side effect — the first non-blocked call after
+        # the deadline CLAIMS the exclusive probe slot.
+        return hybrid._admit_accelerator()[0]
+
     hybrid.verify_signatures([b"k" * 32], [b"d" * 32], [b"s" * 64])  # trip
     clock["t"] = 1000.0
-    assert not hybrid._breaker_blocks()  # the probe slot
-    assert hybrid._breaker_blocks()      # exclusive: everyone else blocked
+    assert not blocks()  # the probe slot
+    assert blocks()      # exclusive: everyone else blocked
     clock["t"] = 2000.0
-    assert hybrid._breaker_blocks()      # still held by the in-flight probe
-    hybrid._clear_probe()                # probe path releases on non-outage
-    assert not hybrid._breaker_blocks()  # next probe admitted
+    assert blocks()      # still held by the in-flight probe
+    hybrid._clear_probe()  # probe path releases on non-outage
+    assert not blocks()  # next probe admitted
+
+
+def test_stale_success_after_trip_does_not_close_breaker():
+    """Pipeline depth >= 2: a batch submitted BEFORE the outage can surface
+    its success at fetch AFTER a newer failure tripped the circuit (its
+    reply was already in the socket buffer).  That stale evidence must not
+    re-close the breaker or reset the backoff escalation — the route would
+    otherwise flap between dead-backend timeouts all outage long."""
+    tpu = ScriptedTpuBackend()
+    hybrid = HybridSignatureVerifier(tpu=tpu, cpu=StubCpuBackend(),
+                                     threshold=1)
+    batch = ([b"k" * 32], [b"d" * 32], [b"s" * 64])
+    d0 = hybrid.verify_signatures_async(*batch)  # submitted while healthy
+    d1 = hybrid.verify_signatures_async(*batch)  # submitted while healthy
+    tpu.dead = True
+    assert d1.result() == [True]  # outage at fetch: trips, oracle serves it
+    assert hybrid.breaker_open
+    backoff = hybrid._breaker_backoff_s
+    tpu.dead = False  # d0's reply was buffered pre-outage
+    assert d0.result() == [True]  # succeeds on the accelerator route
+    assert hybrid.breaker_open, "stale success must not close the circuit"
+    assert hybrid._breaker_backoff_s == backoff
+
+
+def test_non_probe_fetch_failure_keeps_probe_exclusivity():
+    """While a probe is in flight, a pre-outage straggler failing at fetch
+    trips the breaker again but must NOT release the hung probe's exclusive
+    slot — 'a hung probe admits no further victims' has to survive
+    concurrent non-probe failures."""
+    clock = {"t": 0.0}
+    tpu = ScriptedTpuBackend()
+    hybrid = HybridSignatureVerifier(tpu=tpu, cpu=StubCpuBackend(),
+                                     threshold=1)
+    hybrid._breaker_clock = lambda: clock["t"]
+    batch = ([b"k" * 32], [b"d" * 32], [b"s" * 64])
+    straggler = hybrid.verify_signatures_async(*batch)  # healthy submit
+    tpu.dead = True
+    hybrid.verify_signatures(*batch)  # trip
+    assert hybrid.breaker_open
+    clock["t"] = 1000.0
+    assert not hybrid._admit_accelerator()[0]  # probe admitted (now hung)
+    assert straggler.result() == [True]  # fails at fetch -> oracle serves it
+    clock["t"] = 2000.0
+    assert hybrid._admit_accelerator()[0], \
+        "a non-probe failure must not readmit dispatches past a live probe"
 
 
 def test_breaker_counts_degraded_batches_not_trips():

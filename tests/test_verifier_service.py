@@ -283,3 +283,206 @@ def test_service_with_real_jax_backend(tmp_path, signers):
         assert ok == [True] * 5 + [False] + [True] * 2
 
     asyncio.run(_with_server(tmp_path, keys, None, scenario))
+
+
+def test_empty_hello_does_not_poison_the_committee(tmp_path, signers):
+    """ADVICE r5: a first HELLO with ZERO keys (a RAW-only client) must not
+    be adopted as the service committee — later clients presenting the real
+    committee used to get a permanent 'committee mismatch' ERR."""
+    keys = [s.public_key.bytes for s in signers]
+
+    async def scenario(server):
+        keyless = RemoteSignatureVerifier(socket_path=server.socket_path)
+        await asyncio.to_thread(keyless.warmup)  # HELLO with 0 keys
+        # RAW verifies work for the keyless client...
+        stranger = crypto.Signer.from_seed(b"\x77" * 32)
+        digest = crypto.blake2b_256(b"raw-only")
+        ok = await asyncio.to_thread(
+            keyless.verify_signatures,
+            [stranger.public_key.bytes], [digest], [stranger.sign(digest)],
+        )
+        assert ok == [True]
+        # ...and the first REAL committee still establishes the key set.
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        await asyncio.to_thread(client.warmup)
+        pks, digests, sigs = _sigs(4, signers)
+        ok = await asyncio.to_thread(
+            client.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True] * 4
+
+    asyncio.run(_with_server(tmp_path, None, CountingBackend(), scenario))
+
+
+def test_server_pipelines_requests_on_one_connection(tmp_path, signers):
+    """The service reads/decodes request N+1 while N computes: two
+    back-to-back requests on ONE connection against a slow backend complete
+    in ~one compute time, not two (the stop-and-wait shape), and replies
+    come back in request order."""
+    import struct
+    import time as _time
+
+    from mysticeti_tpu.verifier_service import T_RAW, _frame
+
+    # Wide enough that scheduler noise on a loaded 2-core CI box stays
+    # small against the overlap margin (serial = 2*delay, gate = 1.8*delay).
+    delay = 0.3
+    keys = [s.public_key.bytes for s in signers]
+
+    class SlowBackend(CountingBackend):
+        def verify_signatures(self, public_keys, digests, signatures):
+            _time.sleep(delay)
+            return super().verify_signatures(public_keys, digests, signatures)
+
+    async def scenario(server):
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        pks, digests, sigs = _sigs(2, signers)
+        body = b"".join(
+            pk + d + s for pk, d, s in zip(pks, digests, sigs)
+        )
+
+        def pipelined():
+            conn = client._connect()
+            try:
+                for req_id in (1, 2):
+                    conn.sendall(
+                        _frame(T_RAW, struct.pack("<II", req_id, 2) + body)
+                    )
+                started = _time.monotonic()
+                out = []
+                for expect in (1, 2):
+                    type_, payload = client._read_frame(conn)
+                    (echoed,) = struct.unpack_from("<I", payload)
+                    out.append((type_, echoed, list(payload[4:])))
+                return _time.monotonic() - started, out
+            finally:
+                conn.close()
+
+        elapsed, replies = await asyncio.to_thread(pipelined)
+        assert [r[1] for r in replies] == [1, 2]  # in request order
+        assert all(r[2] == [1, 1] for r in replies)
+        # Overlapped: well under 2 x the per-request compute.
+        assert elapsed < 2 * delay * 0.9, elapsed
+
+    asyncio.run(_with_server(tmp_path, keys, SlowBackend(), scenario))
+
+
+def test_client_async_dispatch_overlaps_and_survives_restart(tmp_path, signers):
+    """verify_signatures_async sends now and reads at result(): two
+    in-flight requests overlap through the service, and a service restart
+    between submit and fetch re-runs the batch through the sync retry path
+    instead of losing it."""
+    import time as _time
+
+    # Wide enough that scheduler noise on a loaded 2-core CI box stays
+    # small against the overlap margin (serial = 2*delay, gate = 1.8*delay).
+    delay = 0.3
+    keys = [s.public_key.bytes for s in signers]
+
+    class SlowBackend(CountingBackend):
+        def verify_signatures(self, public_keys, digests, signatures):
+            _time.sleep(delay)
+            return super().verify_signatures(public_keys, digests, signatures)
+
+    async def main():
+        server = VerifierServer(
+            str(tmp_path / "verifier.sock"), committee_keys=keys,
+            backend=SlowBackend(),
+        )
+        await server.start()
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        pks, digests, sigs = _sigs(4, signers)
+
+        def overlapped():
+            # Pay warmup + server-side calibration + pool connects OUTSIDE
+            # the timed region (the pure-Python oracle's 256-sig calibration
+            # costs ~1 s), then time two overlapped in-flight requests.
+            w1 = client.verify_signatures_async(pks, digests, sigs)
+            w2 = client.verify_signatures_async(pks, digests, sigs)
+            w1.result(), w2.result()  # two pooled conns now warm
+            started = _time.monotonic()
+            h1 = client.verify_signatures_async(pks, digests, sigs)
+            h2 = client.verify_signatures_async(pks, digests, sigs)
+            out = (h1.result(), h2.result())
+            return _time.monotonic() - started, out
+
+        try:
+            elapsed, (r1, r2) = await asyncio.to_thread(overlapped)
+            assert r1 == [True] * 4 and r2 == [True] * 4
+            assert elapsed < 2 * delay * 0.9, elapsed
+            # Submit, then kill and restart the service before fetching.
+            handle = await asyncio.to_thread(
+                client.verify_signatures_async, pks, digests, sigs
+            )
+        finally:
+            await server.stop()
+        server2 = VerifierServer(
+            str(tmp_path / "verifier.sock"), committee_keys=keys,
+            backend=CountingBackend(),
+        )
+        await server2.start()
+        try:
+            assert await asyncio.to_thread(handle.result) == [True] * 4
+        finally:
+            await server2.stop()
+
+    asyncio.run(main())
+
+
+def test_pipelined_hello_then_verify_waits_for_committee(tmp_path, signers):
+    """A client that pipelines HELLO + VERIFY without waiting for HELLO_OK
+    must still get correct verdicts: the verify may not EXECUTE before the
+    HELLO that establishes the committee finishes (it would see no keys and
+    report every slot invalid)."""
+    import struct
+
+    from mysticeti_tpu.verifier_service import (
+        T_HELLO,
+        T_HELLO_OK,
+        T_RESULT,
+        T_VERIFY,
+        _frame,
+    )
+
+    keys = [s.public_key.bytes for s in signers]
+
+    async def scenario(server):
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        pks, digests, sigs = _sigs(3, signers)
+        body = b"".join(
+            struct.pack("<H", keys.index(pk)) + d + s
+            for pk, d, s in zip(pks, digests, sigs)
+        )
+
+        def pipelined():
+            import socket as _socket
+
+            conn = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            conn.settimeout(30)
+            conn.connect(server.socket_path)
+            try:
+                hello = struct.pack("<H", len(keys)) + b"".join(keys)
+                # HELLO and VERIFY in ONE write: no wait for HELLO_OK.
+                conn.sendall(
+                    _frame(T_HELLO, hello)
+                    + _frame(T_VERIFY, struct.pack("<II", 9, 3) + body)
+                )
+                t1, _ = client._read_frame(conn)
+                t2, payload = client._read_frame(conn)
+                return t1, t2, list(payload[4:])
+            finally:
+                conn.close()
+
+        t1, t2, oks = await asyncio.to_thread(pipelined)
+        assert t1 == T_HELLO_OK and t2 == T_RESULT
+        assert oks == [1, 1, 1], oks  # NOT all-zeros
+
+    asyncio.run(_with_server(tmp_path, None, CountingBackend(), scenario))

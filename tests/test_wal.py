@@ -154,3 +154,35 @@ def test_replay_sees_queued_appends(tmp_path):
     assert [s[0] for s in seen] == positions
     assert all(tag == 3 for _, tag, _ in seen)
     writer.close()
+
+
+def test_own_proposal_drains_async_append_queue(tmp_path):
+    """ADVICE r5 durability window: a proposal must not leave the WAL append
+    parked in process memory when it becomes externally visible — the core
+    drains the writer queue (flush, no fsync) before handing the block to
+    dissemination, so a plain process crash cannot un-propose a broadcast
+    block (restart-equivocation)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from helpers import committee_and_cores
+
+    _committee, cores = committee_and_cores(4, str(tmp_path))
+    core = cores[0]
+    assert core.wal_writer._async  # the deployed default: async appends
+    core.run_block_handler([])
+    block = core.try_new_block()
+    assert block is not None
+    # Every acknowledged append — the proposal included — has left the
+    # in-flight queue by the time try_new_block returns.
+    assert core.wal_writer._inflight == {}
+    # And a reader replaying the log NOW (the crash-recovery view, no
+    # flush assist) sees the proposal entry on disk.
+    from mysticeti_tpu.wal import WalReader
+
+    reader = WalReader(os.path.join(str(tmp_path), "wal-0"))
+    try:
+        payloads = [payload for _, _, payload in reader.iter_until()]
+        assert any(block.to_bytes() in p for p in payloads)
+    finally:
+        reader.close()
