@@ -80,6 +80,15 @@ GUARDED_FIELDS: Dict[str, str] = {
     "_breaker_gen": "_ema_lock",
     "_breaker_open_until": "_ema_lock",
     "_breaker_probing": "_ema_lock",
+    # Backend pin (zero-tax short-circuit routing): pinned/probed/unpinned
+    # from concurrent dispatch threads; shares the EMA lock like the breaker.
+    "_pinned_backend": "_ema_lock",
+    "_pin_backoff_s": "_ema_lock",
+    "_pin_next_probe_t": "_ema_lock",
+    # Batching collector arrival-rate EMA: read-modify-written under the
+    # pending-queue lock alongside the dispatch EMA it modulates.
+    "_arrival_gap_ema_s": "_lock",
+    "_last_arrival_t": "_lock",
     # RemoteSignatureVerifier's staged-dispatch connection pool: checked
     # out/in from any executor thread; the live-connection count must move
     # with the deque under one lock or the bound drifts.

@@ -105,6 +105,14 @@ class SignatureVerifier:
         first real batch arrives.  Called from a background thread at node
         boot; default no-op."""
 
+    def resolved_backend(self) -> str:
+        """The platform this verifier's dispatches ACTUALLY land on.  Host
+        oracles are "cpu"; accelerator backends override with the live
+        runtime's answer so the verifier service can advertise it over
+        HELLO_OK (and clients can short-circuit a service with no chip
+        behind it)."""
+        return "cpu"
+
     def padded_batch(self, n: int) -> int:
         """Device lanes an ``n``-signature dispatch actually occupies; the
         host paths pay no padding.  Telemetry only (padding waste =
@@ -172,6 +180,14 @@ class TpuSignatureVerifier(SignatureVerifier):
             self.verify_signatures(
                 pks, [dummy] * len(pks), [bytes(64)] * len(pks)
             )
+
+    def resolved_backend(self) -> str:
+        """The live JAX platform ("cpu" when no accelerator is attached or
+        the runtime degraded to the host) — what HELLO_OK advertises when
+        this backend sits behind the verifier service."""
+        import jax
+
+        return str(jax.default_backend())
 
     def padded_batch(self, n: int) -> int:
         """Lanes dispatched for n signatures under the kernel's fixed bucket
@@ -271,6 +287,11 @@ class HybridSignatureVerifier(SignatureVerifier):
     BREAKER_EXCEPTIONS = (ConnectionError, TimeoutError, OSError)
     BREAKER_BASE_BACKOFF_S = 1.0
     BREAKER_MAX_BACKOFF_S = 30.0
+    # Advertised backends with no accelerator behind them (HELLO_OK suffix,
+    # verifier_service.py): a service running on one of these has nothing to
+    # offload TO — routing pins to the in-process oracle and the socket goes
+    # silent (zero frames per batch) until a re-HELLO probe sees an upgrade.
+    CPU_ONLY_BACKENDS = frozenset({"cpu"})
 
     def __init__(
         self,
@@ -303,6 +324,14 @@ class HybridSignatureVerifier(SignatureVerifier):
         self._breaker_gen = 0
         self._breaker_rng = random.Random(0x0B7EA6E5)
         self._breaker_clock = time.monotonic  # injectable for tests
+        # Backend pin (shares _ema_lock and the breaker's probe-exclusivity
+        # flag): while the remote side advertises a CPU-only backend, every
+        # batch short-circuits to the in-process oracle and a low-frequency
+        # re-HELLO probe (jittered exponential backoff, same schedule
+        # constants as the breaker) watches for an accelerator upgrade.
+        self._pinned_backend: Optional[str] = None
+        self._pin_backoff_s = 0.0
+        self._pin_next_probe_t = 0.0
         # Routing label of the dispatch that ran in THIS thread: the batching
         # collector reads it right after verify_signatures returns, in the
         # same executor thread, so thread-local storage is exactly the
@@ -352,6 +381,8 @@ class HybridSignatureVerifier(SignatureVerifier):
         ``_route_to_tpu`` by construction."""
         import math
 
+        if self._pinned_backend is not None:
+            return self.NEVER  # CPU-only backend: nothing to offload to
         if self._fixed_threshold is not None:
             return self._fixed_threshold
         if not (self.cpu_per_sig_s > 0.0 and self.tpu_dispatch_s > 0.0):
@@ -441,6 +472,113 @@ class HybridSignatureVerifier(SignatureVerifier):
         with self._ema_lock:
             self._breaker_probing = False
 
+    # -- backend pin (short-circuit routing) --
+
+    @property
+    def pinned_backend(self) -> Optional[str]:
+        """The CPU-only backend routing is currently pinned against, or
+        None when offload is open (introspection/tests)."""
+        return self._pinned_backend
+
+    def _sync_pin_with_advertisement(self) -> None:
+        """Cheap per-batch attr read: a mid-run reconnect (service restart)
+        can change the remote client's advertised backend between probes —
+        a CPU-only advertisement pins routing the moment any thread sees
+        it, not a probe interval later."""
+        adv = getattr(self.tpu, "advertised_backend", None)
+        if adv in self.CPU_ONLY_BACKENDS and self._pinned_backend is None:
+            self._pin_routing(adv)
+
+    def _pin_routing(self, backend: str) -> None:
+        now = self._breaker_clock()
+        with self._ema_lock:
+            if self._pinned_backend is not None:
+                return
+            self._pinned_backend = backend
+            self._pin_backoff_s = self.BREAKER_BASE_BACKOFF_S
+            self._pin_next_probe_t = now + jittered_backoff(
+                self._pin_backoff_s, self._breaker_rng
+            )
+        log.info(
+            "verifier backend %r has no accelerator: routing pinned to the "
+            "in-process oracle (re-HELLO upgrade probe in ~%.1f s)",
+            backend, self.BREAKER_BASE_BACKOFF_S,
+        )
+
+    def _admit_pin_probe(self) -> bool:
+        """At most one re-HELLO upgrade probe at a time, past the backoff
+        deadline — the ``_breaker_probing`` flag is shared with
+        ``_admit_accelerator`` so a hung HELLO admits no further probes and
+        never races a breaker probe for the same exclusivity."""
+        with self._ema_lock:
+            if self._pinned_backend is None:
+                return False
+            now = self._breaker_clock()
+            if self._breaker_probing or now < self._pin_next_probe_t:
+                return False
+            self._breaker_probing = True
+            return True
+
+    def _finish_pin_probe(self, backend: Optional[str], calibration,
+                          probed: bool = False) -> None:
+        """Probe outcome.  With ``probed`` (the re-HELLO round-trip actually
+        completed): any answer that is not a CPU-only advertisement unpins —
+        including NO advertisement (a pre-r6 service replaced the one that
+        pinned us; its platform is unknown, and unknown must never stay
+        pinned — the same conservative default that refuses to pin in the
+        first place), and a fresh calibration reseeds the cost model.
+        Without ``probed`` (unreachable service, no rehello support, or an
+        abandoned probe) the pin stands and the backoff doubles, decaying
+        the steady-state probe cost to one HELLO per
+        ``BREAKER_MAX_BACKOFF_S``."""
+        now = self._breaker_clock()
+        upgraded = probed and backend not in self.CPU_ONLY_BACKENDS
+        with self._ema_lock:
+            self._breaker_probing = False
+            if upgraded:
+                self._pinned_backend = None
+                self._pin_backoff_s = 0.0
+                if calibration is not None:
+                    self.tpu_dispatch_s, self.tpu_per_sig_s = calibration
+            else:
+                self._pin_backoff_s = min(
+                    self._pin_backoff_s * 2.0, self.BREAKER_MAX_BACKOFF_S
+                )
+                self._pin_next_probe_t = now + jittered_backoff(
+                    self._pin_backoff_s, self._breaker_rng
+                )
+        if upgraded:
+            log.info(
+                "verifier service re-advertised backend %r: offload "
+                "re-opened", backend,
+            )
+
+    def _reprobe_pin_and_verify(self, public_keys, digests, signatures, n):
+        """Fetch-stage body of the probe-carrying batch: ONE re-HELLO round
+        trip (never a verify frame), then the batch verifies on the oracle
+        exactly as its window-mates did.  A service outage here is not an
+        outage of the route in use — the pin already avoids the socket — so
+        it only pushes the next probe out, never trips the breaker."""
+        backend = calibration = None
+        probed = False
+        try:
+            rehello = getattr(self.tpu, "rehello", None)
+            if rehello is not None:
+                backend, calibration = rehello()
+                probed = True
+        except VerifierProtocolError as exc:
+            log.warning(
+                "pin re-probe HELLO rejected (%r): staying on the oracle",
+                exc,
+            )
+        except self.BREAKER_EXCEPTIONS as exc:
+            log.debug(
+                "pin re-probe HELLO failed (%r): staying on the oracle", exc
+            )
+        finally:
+            self._finish_pin_probe(backend, calibration, probed=probed)
+        return self._verify_cpu(public_keys, digests, signatures, n)
+
     def warmup(self) -> None:
         from . import crypto
 
@@ -468,6 +606,10 @@ class HybridSignatureVerifier(SignatureVerifier):
             if isinstance(exc, VerifierProtocolError):
                 raise  # misconfiguration, not an outage: fail fast
             self._trip_breaker(exc)
+        # The warmup HELLO told us what actually answers behind the socket:
+        # a CPU-only backend pins routing before the first real batch, so
+        # even boot traffic never pays the socket round-trip for nothing.
+        self._sync_pin_with_advertisement()
         started = time.monotonic()
         reps = 32
         self.cpu.verify_signatures([pk] * reps, [digest] * reps, [sig] * reps)
@@ -511,11 +653,34 @@ class HybridSignatureVerifier(SignatureVerifier):
         n = len(signatures)
         if n == 0:
             return CompletedDispatch([])
+        self._sync_pin_with_advertisement()
+        if self._pinned_backend is not None:
+            # Short-circuit: the service advertised a CPU-only backend, so
+            # the batch completes wholly in-process — zero socket frames,
+            # zero collector serialization toward the wire.  At most one
+            # batch per backoff interval carries the re-HELLO upgrade probe
+            # into its fetch stage (a HELLO frame, never a verify).
+            if self.metrics is not None:
+                self.metrics.verify_shortcircuit_total.labels(
+                    "backend-cpu"
+                ).inc()
+            if self._admit_pin_probe():
+                return _PinProbeDispatch(
+                    self, public_keys, digests, signatures, n
+                )
+            return DeferredDispatch(
+                self._verify_cpu, public_keys, digests, signatures, n
+            )
         degraded = False
+        breaker_blocked = False
         if self._route_to_tpu(n):
             blocked, is_probe = self._admit_accelerator()
             if blocked:
-                degraded = True  # circuit open: the route is held closed
+                # Circuit open: the route is held closed and the batch
+                # never touches the socket (unlike a mid-dispatch failure
+                # below, which may have sent frames before raising).
+                degraded = True
+                breaker_blocked = True
             else:
                 # Captured BEFORE the submit: a trip racing the submission
                 # means this dispatch's eventual success is ambiguous
@@ -541,8 +706,18 @@ class HybridSignatureVerifier(SignatureVerifier):
                         self, handle, public_keys, digests, signatures, n,
                         is_probe, gen,
                     )
-        if degraded and self.metrics is not None:
-            self.metrics.verifier_fallback_total.inc()
+        if self.metrics is not None:
+            if degraded:
+                self.metrics.verifier_fallback_total.inc()
+                if breaker_blocked:
+                    self.metrics.verify_shortcircuit_total.labels(
+                        "breaker"
+                    ).inc()
+            else:
+                # The cost-model router decided against offloading: the
+                # batch must never touch the socket — and doesn't (the
+                # oracle runs in-process at the fetch stage).
+                self.metrics.verify_shortcircuit_total.labels("router").inc()
         return DeferredDispatch(
             self._verify_cpu, public_keys, digests, signatures, n
         )
@@ -600,6 +775,30 @@ class HybridSignatureVerifier(SignatureVerifier):
             self.tpu_per_sig_s = _update_ema(
                 self.tpu_per_sig_s, implied_marginal, self.EMA_OUTLIER_S
             )
+
+class _PinProbeDispatch:
+    """The pinned route's probe-carrying batch: ``result()`` runs the
+    re-HELLO + oracle verify on the fetch stage's executor thread.  The
+    handle OWNS the shared probe-exclusivity flag from admission, so a
+    flush cancelled between submit and fetch must release it via
+    ``abandon()`` — a bare DeferredDispatch here would strand the flag
+    forever (no further pin probes, and the breaker's own probes blocked),
+    the exact leak PR 4's abandon protocol exists to prevent."""
+
+    __slots__ = ("_hybrid", "_args")
+
+    def __init__(self, hybrid, public_keys, digests, signatures, n) -> None:
+        self._hybrid = hybrid
+        self._args = (public_keys, digests, signatures, n)
+
+    def result(self) -> List[bool]:
+        return self._hybrid._reprobe_pin_and_verify(*self._args)
+
+    def abandon(self) -> None:
+        """Released without fetching: not a completed probe (``probed``
+        stays False), so the pin stands and only the backoff advances."""
+        self._hybrid._finish_pin_probe(None, None)
+
 
 class _HybridTpuDispatch:
     """An in-flight TPU-routed batch of the hybrid verifier.
@@ -957,10 +1156,23 @@ class BatchedSignatureVerifier(BlockVerifier):
         # slower than EMA_OUTLIER_S (one-time JAX compiles) are not fed into
         # the EMA at all.
         self._dispatch_ema_s = 0.0
+        # Arrival-rate EMA (loop-clocked, so it reads VIRTUAL time under the
+        # deterministic simulator and seeded sims stay byte-identical): the
+        # collection window only pays off when more arrivals are coming.
+        # At low load the window shrinks toward the floor instead of taxing
+        # every lone block with the full batch window — see
+        # ``_effective_delay_s``.
+        self._arrival_gap_ema_s = 0.0
+        self._last_arrival_t: Optional[float] = None
 
     MAX_ADAPTIVE_DELAY_S = 0.1
     MIN_ADAPTIVE_DELAY_S = 0.0005
     EMA_OUTLIER_S = 5.0
+    # Inter-arrival gaps are clamped here before entering the EMA: an idle
+    # stretch means "low rate" (signal, fed in at the cap), not an outlier
+    # to discard — but it must not drag the EMA so far that a resuming
+    # burst needs minutes of samples to recover the window.
+    ARRIVAL_GAP_CAP_S = 1.0
 
     def _pipeline_fixed_cost(self) -> float:
         """Fixed dispatch cost estimate for the adaptive pipeline depth: the
@@ -991,20 +1203,66 @@ class BatchedSignatureVerifier(BlockVerifier):
         dispatch measured yet).  Tunneled chip (~100 ms dispatch) -> 20 ms
         window; saturated CPU batch (~30 ms) -> 6 ms; light-load CPU route
         (~0.5 ms) -> the 0.5 ms floor.
+
+        On top of that dispatch-cost CEILING, the window is arrival-rate-
+        adaptive: waiting is only worth it when more blocks are coming.
+        With ``ceiling / gap_ema`` expected further arrivals inside the
+        window, a rate that would deliver fewer than ~2 scales the wait
+        down linearly (to the floor) — a lone steady-state block flushes
+        almost immediately instead of paying the full batch window, while
+        dense arrivals (gap << window) and same-tick frame bursts keep the
+        full window and batch exactly as before.  Saturation is unaffected
+        either way: ``max_batch`` arrivals flush without any timer.
         """
         ema = self._dispatch_ema_s
         if ema == 0.0:
-            return self.max_delay_s
-        return max(
-            self.MIN_ADAPTIVE_DELAY_S,
-            min(0.2 * ema, self.MAX_ADAPTIVE_DELAY_S),
+            ceiling = self.max_delay_s
+        else:
+            ceiling = max(
+                self.MIN_ADAPTIVE_DELAY_S,
+                min(0.2 * ema, self.MAX_ADAPTIVE_DELAY_S),
+            )
+        gap = self._arrival_gap_ema_s
+        if gap <= 0.0:
+            return ceiling
+        expected = ceiling / gap  # further arrivals inside a full window
+        if expected >= 2.0:
+            return ceiling
+        return max(self.MIN_ADAPTIVE_DELAY_S, ceiling * expected / 2.0)
+
+    def _schedule_flush(self, loop) -> None:
+        """Arm the window timer (caller holds ``self._lock``) and publish
+        the chosen window — the adaptive curve is otherwise invisible when
+        a misroute needs debugging."""
+        delay = self._effective_delay_s()
+        if self.metrics is not None:
+            self.metrics.verify_collector_window_seconds.set(delay)
+        self._flush_task = loop.call_later(
+            delay, lambda: spawn_logged(self._flush(), log, name="verify-flush")
         )
 
     async def verify(self, block: StatementBlock) -> None:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         window = None
+        # Loop clock, not the wall: virtual under the simulator, so the
+        # adaptive window cannot make a seeded sim's flush schedule depend
+        # on host weather.
+        now = loop.time()
         with self._lock:
+            last = self._last_arrival_t
+            self._last_arrival_t = now
+            if last is not None:
+                gap = min(now - last, self.ARRIVAL_GAP_CAP_S)
+                # Same-tick arrivals (gather bursts, one frame's blocks)
+                # sample as 0.0 — pulling the EMA toward "dense", which is
+                # exactly what they are; a zero first sample leaves the EMA
+                # unseeded (full window) rather than pinning it there.
+                self._arrival_gap_ema_s = (
+                    gap
+                    if self._arrival_gap_ema_s == 0.0
+                    else 0.8 * self._arrival_gap_ema_s + 0.2 * gap
+                )
             self._pending.append((block, future))
             if len(self._pending) >= self.max_batch:
                 # Take the full window NOW (max_batch stays the dispatch
@@ -1015,10 +1273,7 @@ class BatchedSignatureVerifier(BlockVerifier):
                     self._flush_task.cancel()
                     self._flush_task = None
             elif self._flush_task is None:
-                self._flush_task = loop.call_later(
-                    self._effective_delay_s(),
-                    lambda: spawn_logged(self._flush(), log, name="verify-flush"),
-                )
+                self._schedule_flush(loop)
         if window is not None:
             # Flush as its own task instead of awaiting it: the PRIOR
             # window's dispatch may still be in flight, and the staged
@@ -1315,10 +1570,7 @@ class BatchedSignatureVerifier(BlockVerifier):
                 # Oldest first: deferred entries re-enter at the head.
                 self._pending[:0] = requeue
                 if self._flush_task is None:
-                    self._flush_task = loop.call_later(
-                        self._effective_delay_s(),
-                        lambda: spawn_logged(self._flush(), log, name="verify-flush"),
-                    )
+                    self._schedule_flush(loop)
         return results
 
     async def verify_blocks(self, blocks: Sequence[StatementBlock]) -> List[bool]:

@@ -36,7 +36,16 @@ class Identifier:
 
 @dataclass
 class SynchronizerParameters:
-    """Dissemination/fetch tuning (config.rs:76-100)."""
+    """Dissemination/fetch tuning (config.rs:76-100).
+
+    ``disseminate_others_blocks`` arms the helper streams the reference
+    keeps dormant (synchronizer.rs:169-205): when on, a node missing a live
+    connection to some authority asks up to ``maximum_helpers_per_authority``
+    of its connected peers (``absolute_maximum_helpers`` total across
+    authorities) to relay that authority's blocks as a push stream.  Off by
+    default — it emits a wire tag pre-knob receivers reset on
+    (docs/wire-format.md §7), and the pull fetcher already covers the gap
+    at higher latency."""
 
     absolute_maximum_helpers: int = 32
     maximum_helpers_per_authority: int = 2
@@ -44,6 +53,7 @@ class SynchronizerParameters:
     sample_precision_s: float = 0.25
     stream_interval_s: float = 1.0
     new_stream_threshold: int = 10
+    disseminate_others_blocks: bool = False
 
 
 @dataclass

@@ -292,6 +292,27 @@ class Metrics:
             buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
                      0.5, 1.0, 5.0],
         )
+        # Zero-tax data plane (the no-chip flavor parity work): which
+        # batches never touched the socket, what the wire actually carried,
+        # and the window the adaptive collector chose.
+        self.verify_shortcircuit_total = counter(
+            "verify_shortcircuit_total",
+            "signature batches completed without touching the verifier "
+            "service socket (reason: backend-cpu = service advertised a "
+            "CPU-only backend, router = cost model chose the in-process "
+            "oracle, breaker = circuit open)",
+            labels=("reason",),
+        )
+        self.verify_wire_bytes_total = counter(
+            "verify_wire_bytes_total",
+            "bytes moved over the verifier-service socket by this client",
+            labels=("direction",),
+        )
+        self.verify_collector_window_seconds = gauge(
+            "verify_collector_window_seconds",
+            "collection window the batching collector last armed "
+            "(arrival-rate-adaptive, ceilinged by the dispatch-cost window)",
+        )
         self.verifier_fallback_total = counter(
             "verifier_fallback_total",
             "signature batches degraded to the CPU oracle because the "

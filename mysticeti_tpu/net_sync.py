@@ -33,6 +33,7 @@ from .network import (
     Connection,
     RequestBlocks,
     RequestBlocksResponse,
+    SubscribeOthersFrom,
     SubscribeOwnFrom,
 )
 from .syncer import Syncer, SyncerSignals
@@ -40,7 +41,7 @@ from .tracing import logger
 from .utils.tasks import spawn_logged
 
 log = logger(__name__)
-from .synchronizer import BlockDisseminator, BlockFetcher
+from .synchronizer import BlockDisseminator, BlockFetcher, HelperSubscriptions
 from .types import AuthoritySet, StatementBlock, VerificationError
 
 CLEANUP_INTERVAL_S = 10.0
@@ -127,6 +128,10 @@ class NetworkSyncer:
         )
         self._tasks: List[asyncio.Task] = []
         self._disseminators: Dict[int, BlockDisseminator] = {}
+        # Helper-stream bookkeeping (requester side; armed by the
+        # disseminate_others_blocks knob): which connected peers relay which
+        # unreachable authority's blocks for us, within the config caps.
+        self._helper_subs = HelperSubscriptions(self.parameters.synchronizer)
         self._stopped = asyncio.Event()
         self._wal_sync_thread: Optional[threading.Thread] = None
         self._start_wal_sync_thread = start_wal_sync_thread
@@ -222,6 +227,11 @@ class NetworkSyncer:
         # Ask the peer for its own blocks we have not yet seen.
         last_seen = self.core.block_store.last_seen_by_authority(peer)
         await connection.send(SubscribeOwnFrom(last_seen))
+        # A direct stream from this authority makes any relay of its blocks
+        # redundant; forgetting the ask lets a later outage re-request.
+        self._helper_subs.drop_authority(peer)
+        if self.parameters.synchronizer.disseminate_others_blocks:
+            await self._request_helper_streams(connection)
         # Per-connection verification pipeline: the reader overlaps many
         # in-flight signature batches (the accelerator's round-trip would
         # otherwise serialize the connection at one batch per RTT), while the
@@ -244,6 +254,13 @@ class NetworkSyncer:
                     break
                 if isinstance(msg, SubscribeOwnFrom):
                     disseminator.subscribe_own_from(msg.round)
+                elif isinstance(msg, SubscribeOthersFrom):
+                    # Serving side of the helper streams: answer whenever
+                    # asked (the knob governs ASKING; the disseminator's
+                    # absolute cap bounds what one peer can demand).
+                    disseminator.subscribe_others_from(
+                        msg.authority, msg.round
+                    )
                 elif isinstance(msg, (Blocks, RequestBlocksResponse)):
                     verified = await self._decode_fresh(msg.blocks)
                     verified = [
@@ -302,6 +319,48 @@ class NetworkSyncer:
             if self.connections.get(peer) is connection:
                 del self.connections[peer]
             connection.close()
+            # Helper-stream hygiene: relays this peer ran for us died with
+            # the connection, and the peer's own blocks now need a relay —
+            # ask the surviving peers (within the config caps) both for the
+            # peer itself and for every authority it was relaying.
+            orphaned = self._helper_subs.drop_helper(peer)
+            if (
+                self.parameters.synchronizer.disseminate_others_blocks
+                and not self._stopped.is_set()
+            ):
+                self._ask_relays_for(peer)
+                for authority in orphaned:
+                    live = self.connections.get(authority)
+                    if live is None or live.is_closed():
+                        self._ask_relays_for(authority)
+
+    def _ask_relays_for(self, authority: int) -> None:
+        """Ask connected peers to relay ``authority``'s blocks (its direct
+        connection just dropped), up to maximum_helpers_per_authority."""
+        last_seen = self.core.block_store.last_seen_by_authority(authority)
+        for helper, conn in list(self.connections.items()):
+            if helper == authority or conn.is_closed():
+                continue
+            if not self._helper_subs.may_ask(authority, helper):
+                continue
+            if conn.try_send(SubscribeOthersFrom(authority, last_seen)):
+                self._helper_subs.note_asked(authority, helper)
+
+    async def _request_helper_streams(self, connection: Connection) -> None:
+        """On a fresh connection: ask it to relay every authority we have
+        no live connection to (late joiner against a partitioned mesh, a
+        peer behind an asymmetric fault), within the config caps."""
+        for authority in range(len(self.core.committee)):
+            if authority in (self.core.authority, connection.peer):
+                continue
+            live = self.connections.get(authority)
+            if live is not None and not live.is_closed():
+                continue
+            if not self._helper_subs.may_ask(authority, connection.peer):
+                continue
+            last_seen = self.core.block_store.last_seen_by_authority(authority)
+            await connection.send(SubscribeOthersFrom(authority, last_seen))
+            self._helper_subs.note_asked(authority, connection.peer)
 
     async def _accept_ordered(
         self, pipeline: asyncio.Queue, connection, inflight: Set[bytes]

@@ -55,10 +55,24 @@ _MSG_RESPONSE = 4
 _MSG_NOT_FOUND = 5
 _MSG_PING = 6
 _MSG_PONG = 7
+_MSG_SUBSCRIBE_OTHERS = 8
 
 
 @dataclasses.dataclass(frozen=True)
 class SubscribeOwnFrom:
+    round: RoundNumber
+
+
+@dataclasses.dataclass(frozen=True)
+class SubscribeOthersFrom:
+    """Helper-stream request (synchronizer.rs:169-205's dormant
+    ``disseminate_others_blocks``, made live behind a Parameters knob):
+    "relay AUTHORITY's blocks you hold, from this round on" — sent to a
+    helper peer when the authority itself is unreachable.  A soft wire
+    extension per docs/wire-format.md §7: receivers that predate the tag
+    reset the connection, so senders only emit it when the knob is on."""
+
+    authority: int
     round: RoundNumber
 
 
@@ -99,6 +113,8 @@ def encode_message(msg: NetworkMessage) -> bytes:
     w = Writer()
     if isinstance(msg, SubscribeOwnFrom):
         w.u8(_MSG_SUBSCRIBE).u64(msg.round)
+    elif isinstance(msg, SubscribeOthersFrom):
+        w.u8(_MSG_SUBSCRIBE_OTHERS).u64(msg.authority).u64(msg.round)
     elif isinstance(msg, Blocks):
         w.u8(_MSG_BLOCKS).u32(len(msg.blocks))
         for b in msg.blocks:
@@ -129,6 +145,8 @@ def decode_message(data: bytes) -> NetworkMessage:
     tag = r.u8()
     if tag == _MSG_SUBSCRIBE:
         msg: NetworkMessage = SubscribeOwnFrom(r.u64())
+    elif tag == _MSG_SUBSCRIBE_OTHERS:
+        msg = SubscribeOthersFrom(r.u64(), r.u64())
     elif tag == _MSG_BLOCKS:
         msg = Blocks(tuple(r.bytes() for _ in range(r.u32())))
     elif tag == _MSG_REQUEST:

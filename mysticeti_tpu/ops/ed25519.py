@@ -97,6 +97,21 @@ if jax.config.jax_compilation_cache_dir is None:
     jax.config.update("jax_compilation_cache_dir", _cache)
     if os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS") is None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    # jax latches cache initialization on the FIRST compile of the process —
+    # and this module's own field/scalar/sha512 imports (above) build
+    # module-level jnp constants that compile before this config block runs,
+    # so the latch lands with the dir still unset and the persistent cache
+    # stays SILENTLY DISABLED for the process lifetime.  Measured on the r6
+    # fleet box: the cache dir had never held a single entry, and every
+    # verifier-service boot re-paid a 2-4 min kernel compile.  Un-latch so
+    # the next compile re-initializes against the configured dir.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        if getattr(_cc, "_cache_initialized", False) and _cc._cache is None:
+            _cc.reset_cache()
+    except (ImportError, AttributeError):  # private API: best-effort only
+        pass
 
 P = F.P
 L = (1 << 252) + 27742317777372353535851937790883648493  # group order

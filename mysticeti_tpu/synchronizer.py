@@ -52,12 +52,71 @@ class BlockDisseminator:
         self.parameters = parameters or SynchronizerParameters()
         self.metrics = metrics
         self._stream_task: Optional[asyncio.Task] = None
+        # Helper streams (synchronizer.rs:169-205, dormant in the reference;
+        # live here behind SynchronizerParameters.disseminate_others_blocks):
+        # one relay task per requested authority, serving OUR stored copies
+        # of that authority's blocks to a peer that lost its direct
+        # connection.  Tests/telemetry read helper_blocks_sent to tell relay
+        # traffic from the own-block stream.
+        self._helper_tasks: Dict[int, asyncio.Task] = {}
+        self.helper_blocks_sent = 0
 
     def subscribe_own_from(self, from_round: RoundNumber) -> None:
         """Peer asked for our blocks starting after ``from_round``."""
         if self._stream_task is not None:
             self._stream_task.cancel()
         self._stream_task = spawn_logged(self._stream_own(from_round), log)
+
+    def subscribe_others_from(
+        self, authority: int, from_round: RoundNumber
+    ) -> None:
+        """Peer asked us to relay ``authority``'s blocks (helper stream).
+
+        One stream per requested authority (a re-subscribe replaces it —
+        same replace-on-resubscribe contract as the own-block stream), with
+        the serving side bounded by ``absolute_maximum_helpers`` so a
+        misbehaving peer cannot fan one connection out into a store-scan
+        per committee member."""
+        existing = self._helper_tasks.pop(authority, None)
+        if existing is not None:
+            existing.cancel()
+        live = sum(1 for t in self._helper_tasks.values() if not t.done())
+        if live >= self.parameters.absolute_maximum_helpers:
+            log.warning(
+                "refusing helper stream for authority %d: %d already live",
+                authority, live,
+            )
+            return
+        self._helper_tasks[authority] = spawn_logged(
+            self._stream_others(authority, from_round), log
+        )
+
+    async def _stream_others(
+        self, authority: int, from_round: RoundNumber
+    ) -> None:
+        """Relay loop: same batch/wake cadence as ``_stream_own`` but walks
+        the store's others-blocks cursor — the peer verifies and re-hashes
+        every relayed block (wire-format §5), so a relay cannot forge."""
+        cursor = from_round
+        batch_size = self.parameters.batch_size
+        while not self.connection.is_closed():
+            waiter = self.block_ready.subscribe()
+            blocks = self.block_store.get_others_blocks(
+                cursor, authority, batch_size
+            )
+            if blocks:
+                cursor = max(b.round() for b in blocks)
+                self.helper_blocks_sent += len(blocks)
+                await self.connection.send(
+                    Blocks(tuple(b.to_bytes() for b in blocks))
+                )
+            else:
+                try:
+                    await asyncio.wait_for(
+                        waiter.wait(), timeout=self.parameters.stream_interval_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
 
     async def _stream_own(self, from_round: RoundNumber) -> None:
         """Push loop (synchronizer.rs:131-164): batch, send, wait for new blocks."""
@@ -101,6 +160,51 @@ class BlockDisseminator:
     def stop(self) -> None:
         if self._stream_task is not None:
             self._stream_task.cancel()
+        for task in self._helper_tasks.values():
+            task.cancel()
+        self._helper_tasks.clear()
+
+
+class HelperSubscriptions:
+    """Requester-side bookkeeping for helper streams (config.rs:76-100's
+    caps): which peers we asked to relay which authority, bounded per
+    authority (``maximum_helpers_per_authority``) and in total
+    (``absolute_maximum_helpers``)."""
+
+    def __init__(self, parameters: SynchronizerParameters) -> None:
+        self.parameters = parameters
+        self._by_authority: Dict[int, set] = {}
+
+    def total(self) -> int:
+        return sum(len(p) for p in self._by_authority.values())
+
+    def may_ask(self, authority: int, helper: int) -> bool:
+        helpers = self._by_authority.get(authority, set())
+        return (
+            helper not in helpers
+            and len(helpers) < self.parameters.maximum_helpers_per_authority
+            and self.total() < self.parameters.absolute_maximum_helpers
+        )
+
+    def note_asked(self, authority: int, helper: int) -> None:
+        self._by_authority.setdefault(authority, set()).add(helper)
+
+    def drop_helper(self, helper: int) -> List[int]:
+        """The helper's connection died: its streams are gone with it.
+        Returns the authorities it was relaying so the caller can re-ask
+        surviving peers — without that, one helper loss silently demotes
+        those authorities back to the pull fetcher's crawl."""
+        orphaned: List[int] = []
+        for authority, helpers in self._by_authority.items():
+            if helper in helpers:
+                helpers.discard(helper)
+                orphaned.append(authority)
+        return orphaned
+
+    def drop_authority(self, authority: int) -> None:
+        """A direct connection to the authority came (back) up: the relay
+        is redundant — forget it so a later outage can re-ask."""
+        self._by_authority.pop(authority, None)
 
 
 class BlockFetcher:

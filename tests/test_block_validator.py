@@ -276,6 +276,160 @@ def test_collection_window_adapts_both_directions():
     assert c._effective_delay_s() == c.MAX_ADAPTIVE_DELAY_S
 
 
+def test_collection_window_adapts_to_arrival_rate():
+    """ISSUE 6 tentpole #3: the window only pays off when more arrivals are
+    coming.  With ~2+ expected arrivals inside a full window the ceiling
+    holds; below that the wait scales down linearly to the floor — a lone
+    steady-state block stops paying the full batch window."""
+    from mysticeti_tpu.block_validator import BatchedSignatureVerifier
+    from mysticeti_tpu.committee import Committee
+
+    c = BatchedSignatureVerifier(Committee.new_for_benchmarks(4))
+    ceiling = c.max_delay_s  # pre-calibration ceiling (5 ms default)
+    # Unseeded arrival EMA: full window (same-tick bursts keep this shape).
+    assert c._effective_delay_s() == ceiling
+    # Dense arrivals (gap << window): the full window still batches.
+    c._arrival_gap_ema_s = 0.0005
+    assert c._effective_delay_s() == ceiling
+    # ~1 expected arrival per window: wait scales to half the ceiling.
+    c._arrival_gap_ema_s = ceiling
+    assert c._effective_delay_s() == pytest.approx(ceiling / 2)
+    # Sparse arrivals (gap >> window): floor — no batch is coming.
+    c._arrival_gap_ema_s = 0.5
+    assert c._effective_delay_s() == c.MIN_ADAPTIVE_DELAY_S
+    # The arrival scaling rides ON the dispatch-cost ceiling: a remote
+    # accelerator's widened window still collapses when arrivals stop.
+    c._dispatch_ema_s = 0.100  # tunneled chip -> 20 ms ceiling
+    c._arrival_gap_ema_s = 0.002
+    assert abs(c._effective_delay_s() - 0.020) < 1e-9
+    c._arrival_gap_ema_s = 0.5
+    assert c._effective_delay_s() == c.MIN_ADAPTIVE_DELAY_S
+
+
+def test_collector_tracks_arrival_gaps_and_publishes_window(
+    committee_and_signers,
+):
+    """verify() feeds the loop-clocked inter-arrival gap EMA (capped, so an
+    idle stretch reads as low rate without poisoning the EMA) and each armed
+    window is published on verify_collector_window_seconds."""
+    from mysticeti_tpu.metrics import Metrics
+
+    committee, signers = committee_and_signers
+    metrics = Metrics()
+
+    async def main():
+        backend = CountingVerifier()
+        v = BatchedSignatureVerifier(
+            committee, backend, max_batch=100, max_delay_s=0.02,
+            metrics=metrics,
+        )
+        blocks = [
+            StatementBlock.build(a, 1, [], (), signer=signers[a])
+            for a in range(4)
+        ]
+        first = asyncio.ensure_future(v.verify(blocks[0]))
+        await asyncio.sleep(0.004)
+        rest = [asyncio.ensure_future(v.verify(b)) for b in blocks[1:]]
+        await asyncio.gather(first, *rest)
+        await v.flush_now()
+        # One real ~4 ms gap seeded the EMA; the same-tick trio pulled it
+        # down (0.8 decay per zero sample).
+        assert 0.0 < v._arrival_gap_ema_s <= 0.004 + 0.02
+        assert metrics.verify_collector_window_seconds._value.get() > 0.0
+        # The cap bounds what one idle stretch can inject.
+        v._last_arrival_t = None
+        v._arrival_gap_ema_s = 0.0
+        loop = asyncio.get_running_loop()
+        v._last_arrival_t = loop.time() - 500.0  # pretend: long idle
+        await v.verify(blocks[0])
+        assert v._arrival_gap_ema_s <= v.ARRIVAL_GAP_CAP_S
+        await v.flush_now()
+
+    asyncio.run(main())
+
+
+def test_router_shortcircuit_counter(committee_and_signers):
+    """Batches the cost-model router keeps on the oracle never touch the
+    accelerator backend, and each one counts on
+    verify_shortcircuit_total{reason="router"}."""
+    from mysticeti_tpu.block_validator import (
+        HybridSignatureVerifier,
+        SignatureVerifier,
+    )
+    from mysticeti_tpu.crypto import blake2b_256
+    from mysticeti_tpu.metrics import Metrics
+
+    class NeverBackend(SignatureVerifier):
+        def verify_signatures(self, *args):
+            raise AssertionError("router-rejected batch reached the backend")
+
+    _, signers = committee_and_signers
+    metrics = Metrics()
+    h = HybridSignatureVerifier(
+        tpu=NeverBackend(), cpu=CountingVerifier(), metrics=metrics
+    )
+    digest = blake2b_256(b"router-test")
+    sig = signers[0].sign(digest)
+    pk = signers[0].public_key.bytes
+    # Below DEFAULT_THRESHOLD: the router keeps it in-process.
+    assert h.verify_signatures([pk] * 2, [digest] * 2, [sig] * 2) == [
+        True, True,
+    ]
+    count = metrics.verify_shortcircuit_total.labels("router")._value.get()
+    assert count == 1
+
+
+def test_pin_probe_abandon_releases_exclusivity(committee_and_signers):
+    """A flush cancelled between submit and fetch abandons its probe-
+    carrying handle: the shared probe-exclusivity flag is released (no
+    permanently blocked probes), the pin stands, and a completed probe
+    whose re-HELLO reports an UNKNOWN backend (pre-r6 service) unpins —
+    unknown must never stay pinned."""
+    from mysticeti_tpu.block_validator import (
+        HybridSignatureVerifier,
+        SignatureVerifier,
+        _PinProbeDispatch,
+    )
+    from mysticeti_tpu.crypto import blake2b_256
+
+    class StubRemote(SignatureVerifier):
+        advertised_backend = "cpu"
+        rehello_result = ("cpu", None)
+
+        def rehello(self):
+            return self.rehello_result
+
+        def verify_signatures(self, *args):
+            raise AssertionError("pinned batch reached the remote backend")
+
+    _, signers = committee_and_signers
+    digest = blake2b_256(b"pin-abandon")
+    pks = [signers[0].public_key.bytes] * 2
+    digests, sigs = [digest] * 2, [signers[0].sign(digest)] * 2
+    remote = StubRemote()
+    clock = {"t": 0.0}
+    h = HybridSignatureVerifier(tpu=remote, cpu=CountingVerifier())
+    h._breaker_clock = lambda: clock["t"]
+    h._sync_pin_with_advertisement()
+    assert h.pinned_backend == "cpu"
+    clock["t"] = 100.0  # past the probe deadline
+    handle = h.verify_signatures_async(pks, digests, sigs)
+    assert isinstance(handle, _PinProbeDispatch)
+    assert h._breaker_probing  # the handle owns the exclusive slot
+    handle.abandon()
+    assert not h._breaker_probing, "abandon leaked the probe flag"
+    assert h.pinned_backend == "cpu"  # an abandoned probe is no evidence
+    # The next window's probe still runs — and an unknown-backend answer
+    # (old server replaced the advertiser) unpins.
+    remote.rehello_result = (None, None)
+    clock["t"] = 10_000.0
+    handle = h.verify_signatures_async(pks, digests, sigs)
+    assert isinstance(handle, _PinProbeDispatch)
+    assert handle.result() == [True, True]
+    assert h.pinned_backend is None
+    assert not h._breaker_probing
+
+
 def test_hybrid_never_offloads_to_a_degraded_backend():
     """Round-5 NODE_BENCH finding: a host whose JAX backend degraded to CPU
     measures seconds per dispatch — the budget-relief offload must refuse it

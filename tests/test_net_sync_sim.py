@@ -61,11 +61,12 @@ def build_node(committee, signers, authority, tmp_dir, sim_net, parameters):
 
 
 async def _run_nodes(n, tmp_dir, virtual_seconds, fault=None, leaders=1,
-                     committee=None):
+                     committee=None, parameters=None):
     if committee is None:
         committee = Committee.new_test([1] * n)
     signers = Committee.benchmark_signers(n)
-    parameters = Parameters(leader_timeout_s=1.0, number_of_leaders=leaders)
+    if parameters is None:
+        parameters = Parameters(leader_timeout_s=1.0, number_of_leaders=leaders)
     sim_net = SimulatedNetwork(n)
     nodes = [
         build_node(committee, signers, a, tmp_dir, sim_net, parameters)
@@ -201,12 +202,7 @@ def test_fifty_nodes_commit(tmp_path):
     assert len(leaders) >= 10, sorted(leaders)
 
 
-@pytest.mark.skipif(
-    not os.environ.get("MYSTICETI_BIG_SIMS"),
-    reason="100-authority whole-stack sim: several minutes wall; run with "
-    "MYSTICETI_BIG_SIMS=1 (the driver artifact HUNDRED_r04.json pins it)",
-)
-def test_hundred_nodes_commit(tmp_path):
+def _hundred_nodes_scenario(tmp_path):
     """BASELINE #5-scale committee (100 authorities) through the WHOLE stack
     on the deterministic simulator: uneven stakes, stake-weighted election,
     full net_sync/verify/commit path per node.  The reference's sim tier
@@ -233,6 +229,86 @@ def test_hundred_nodes_commit(tmp_path):
     assert lengths[-1] - lengths[0] <= 8, (lengths[0], lengths[-1])
     leaders = {ref.authority for seq in sequences for ref in seq}
     assert len(leaders) >= 15, sorted(leaders)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("MYSTICETI_BIG_SIMS"),
+    reason="100-authority whole-stack sim: several minutes wall; run with "
+    "MYSTICETI_BIG_SIMS=1 (the driver artifact HUNDRED_r04.json pins it)",
+)
+def test_hundred_nodes_commit(tmp_path):
+    """Driver-artifact entry point (HUNDRED_r0N.json pins MYSTICETI_BIG_SIMS
+    so the scenario also runs standalone in the fast tier on demand)."""
+    _hundred_nodes_scenario(tmp_path)
+
+
+@pytest.mark.slow
+def test_hundred_nodes_commit_slow_tier(tmp_path):
+    """VERDICT r5 weak #7: the env-gated variant above silently does not run
+    in routine CI, so nothing asserted the 100-authority sim stays green
+    between rounds.  This wrapper puts the same scenario in the slow/kernel
+    tier unconditionally — rot shows up as a tier-2 failure, not as a
+    surprise when the next driver artifact is due."""
+    if os.environ.get("MYSTICETI_BIG_SIMS"):
+        pytest.skip("already exercised via test_hundred_nodes_commit")
+    _hundred_nodes_scenario(tmp_path)
+
+
+def test_helper_streams_serve_partitioned_authority(tmp_path):
+    """Others-blocks helper streams (synchronizer.rs:169-205, dormant in the
+    reference; live behind SynchronizerParameters.disseminate_others_blocks):
+    with the 0<->3 link severed, node 3 asks its surviving peers to RELAY
+    authority 0's blocks — a helper that is not the block author serves the
+    stream, and node 3 keeps pace with the fleet."""
+    from mysticeti_tpu.config import SynchronizerParameters
+
+    parameters = Parameters(
+        leader_timeout_s=1.0,
+        synchronizer=SynchronizerParameters(disseminate_others_blocks=True),
+    )
+    relayed = {}
+
+    async def fault(sim_net, nodes):
+        sim_net.partition([0], [3])
+
+        async def probe():
+            # Sample relay counters near the end of the run, while the
+            # connections (and their disseminators) are still alive.
+            await asyncio.sleep(25.0)
+            for helper in (1, 2):
+                d = nodes[helper]._disseminators.get(3)
+                if d is not None:
+                    relayed[helper] = d.helper_blocks_sent
+
+        asyncio.ensure_future(probe())
+
+    nodes = run_simulation(
+        _run_nodes(4, str(tmp_path), 30.0, fault=fault,
+                   parameters=parameters),
+        seed=17,
+    )
+    sequences = [_committed(n) for n in nodes]
+    _assert_prefix_consistent(sequences)
+    # The relay actually carried authority-0 blocks to node 3 (the helper is
+    # by construction not the author: only nodes 1 and 2 can serve it).
+    assert sum(relayed.values()) > 0, relayed
+    # And the cut node kept pace via the push relay — same tight tail the
+    # healthy 4-node run holds, not the fetcher's sample-interval crawl.
+    lengths = sorted(len(s) for s in sequences)
+    assert lengths[0] >= 100, lengths
+    assert lengths[-1] - lengths[0] <= 10, lengths
+
+
+def test_subscribe_others_message_roundtrip():
+    """Wire round-trip of the new soft-extension tag (wire-format §7)."""
+    from mysticeti_tpu.network import (
+        SubscribeOthersFrom,
+        decode_message,
+        encode_message,
+    )
+
+    msg = SubscribeOthersFrom(authority=7, round=12345)
+    assert decode_message(encode_message(msg)) == msg
 
 
 def test_multi_leader_whole_stack(tmp_path):

@@ -435,6 +435,354 @@ def test_client_async_dispatch_overlaps_and_survives_restart(tmp_path, signers):
     asyncio.run(main())
 
 
+def test_cpu_advertising_service_short_circuits(tmp_path, signers):
+    """Acceptance (a): against a service advertising a CPU-only backend, the
+    hybrid verifier pins routing to the in-process oracle — batches complete
+    with ZERO socket frames and verify_shortcircuit_total flips."""
+    from mysticeti_tpu.block_validator import HybridSignatureVerifier
+    from mysticeti_tpu.metrics import Metrics
+
+    keys = [s.public_key.bytes for s in signers]
+    backend = CountingBackend()
+    metrics = Metrics()
+
+    async def scenario(server):
+        remote = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        hybrid = HybridSignatureVerifier(tpu=remote, metrics=metrics)
+        # Frozen clock: the re-HELLO upgrade probe's deadline never passes,
+        # so the steady state under test is PURE short-circuit.
+        hybrid._breaker_clock = lambda: 0.0
+        await asyncio.to_thread(hybrid.warmup)
+        assert remote.advertised_backend == "cpu"
+        assert hybrid.pinned_backend == "cpu"
+        assert hybrid.threshold() == hybrid.NEVER
+        base_calls = backend.calls
+
+        def socket_is_lava(*_a, **_k):
+            raise AssertionError("pinned batch touched the service socket")
+
+        remote.verify_signatures = socket_is_lava
+        remote.verify_signatures_async = socket_is_lava
+        # Well above DEFAULT_THRESHOLD: unpinned, this WOULD offload.
+        pks, digests, sigs = _sigs(40, signers)
+        sigs[5] = bytes(64)
+        expected = [True] * 5 + [False] + [True] * 34
+
+        def run_batch():
+            ok = hybrid.verify_signatures(pks, digests, sigs)
+            return ok, hybrid.backend_label
+
+        for _ in range(2):
+            ok, label = await asyncio.to_thread(run_batch)
+            assert ok == expected
+            assert label == "hybrid-cpu"
+        assert backend.calls == base_calls  # zero frames reached the service
+        flips = metrics.verify_shortcircuit_total.labels(
+            "backend-cpu"
+        )._value.get()
+        assert flips == 2
+
+    asyncio.run(_with_server(tmp_path, keys, backend, scenario))
+
+
+def test_backend_upgrade_reopens_offload_without_restart(tmp_path, signers):
+    """Acceptance (b): when the service re-advertises an accelerator
+    backend (chip window opened / service restarted on real hardware), the
+    pinned hybrid's re-HELLO probe unpins routing and offload resumes — no
+    validator restart."""
+    from mysticeti_tpu.block_validator import HybridSignatureVerifier
+
+    keys = [s.public_key.bytes for s in signers]
+
+    class SwitchableBackend(CountingBackend):
+        platform = "cpu"
+
+        def resolved_backend(self):
+            return self.platform
+
+    backend = SwitchableBackend()
+
+    async def scenario(server):
+        remote = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        clock = {"t": 0.0}
+        hybrid = HybridSignatureVerifier(tpu=remote, threshold=1)
+        hybrid._breaker_clock = lambda: clock["t"]
+        await asyncio.to_thread(hybrid.warmup)
+        assert hybrid.pinned_backend == "cpu"
+        base = backend.calls
+        pks, digests, sigs = _sigs(4, signers)
+        # Pinned: the batch stays on the oracle (no service dispatch).
+        ok = await asyncio.to_thread(
+            hybrid.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True] * 4 and backend.calls == base
+        # The chip arrives: service now resolves to an accelerator.
+        backend.platform = "tpu"
+        clock["t"] = 60.0  # past any jittered probe deadline
+        # This batch carries the re-HELLO probe (still verified on the
+        # oracle — the probe is a HELLO frame, never a verify).
+        ok = await asyncio.to_thread(
+            hybrid.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True] * 4 and backend.calls == base
+        assert remote.advertised_backend == "tpu"
+        assert hybrid.pinned_backend is None
+        # Offload is open again: the next batch rides the socket.
+        ok = await asyncio.to_thread(
+            hybrid.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True] * 4
+        assert backend.calls == base + 1
+
+    asyncio.run(_with_server(tmp_path, keys, backend, scenario))
+
+
+class AuditBuf(bytearray):
+    """bytearray that counts slice-assignments — each one is exactly one
+    copy of payload bytes into the wire buffer (struct.pack_into goes
+    through the C buffer API, so only digest/sig/key copies count)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.writes = 0
+
+    def __setitem__(self, key, value):
+        self.writes += 1
+        super().__setitem__(key, value)
+
+
+class ScriptedSock:
+    """In-memory service endpoint: records what sendall receives (object
+    identity included) and answers each VERIFY/RAW with an all-valid
+    RESULT via recv_into — so the copy/reuse audit is deterministic and
+    socket-free."""
+
+    def __init__(self):
+        self.sent = []
+        self.recv_targets = []
+        self._rx = bytearray()
+
+    def sendall(self, data):
+        assert isinstance(data, memoryview), type(data)
+        self.sent.append((data.obj, len(data)))
+        import struct as _s
+
+        length, type_, req_id, n = _s.unpack_from("<IBII", data)
+        payload = _s.pack("<I", req_id) + b"\x01" * n
+        self._rx += _s.pack("<IB", len(payload), 129) + payload  # T_RESULT
+
+    def recv_into(self, view):
+        assert isinstance(view, memoryview)
+        self.recv_targets.append(view.obj)
+        n = min(len(view), len(self._rx))
+        view[:n] = self._rx[:n]
+        del self._rx[:n]
+        return n
+
+    def close(self):
+        pass
+
+
+def test_pack_path_copies_once_and_reuses_buffer(signers):
+    """Acceptance (c): the pack path performs exactly ONE copy of each
+    digest/signature (and key, on the RAW path) per direction, sends
+    straight from the per-connection buffer (object identity on the
+    socket), and reuses that buffer across >= 10 dispatches with zero
+    reallocation."""
+    keys = [s.public_key.bytes for s in signers]
+    client = RemoteSignatureVerifier(
+        socket_path="/nonexistent.sock", committee_keys=keys
+    )
+    sock = ScriptedSock()
+    client._tls.conn = sock  # bypass connect/HELLO: unit-level wire audit
+    client._tls.req_id = 0
+    pack = client._wire("pack")
+    audit = AuditBuf(len(pack.buf))
+    pack.buf = audit
+
+    pks, digests, sigs = _sigs(8, signers)
+    for i in range(12):
+        before = audit.writes
+        assert client.verify_signatures(pks, digests, sigs) == [True] * 8
+        # T_VERIFY: key rides as a packed index — 2 slice-copies per
+        # record (digest, sig), nothing else touches payload bytes.
+        assert audit.writes - before == 2 * len(sigs)
+    sent_objs = {id(obj) for obj, _ in sock.sent}
+    assert sent_objs == {id(audit)}, "send did not come straight from the buffer"
+    assert pack.buf is audit and pack.grows == 0, "buffer was reallocated"
+    # Receive direction: every reply landed in the same recv buffer via
+    # recv_into (one kernel->buffer copy; no per-chunk concatenation).
+    recv_objs = {id(obj) for obj in sock.recv_targets}
+    assert recv_objs == {id(client._wire("recv").buf)}
+
+    # RAW path (a pk outside the committee): 3 copies per record.
+    stranger = crypto.Signer.from_seed(b"\x55" * 32)
+    digest = crypto.blake2b_256(b"raw-audit")
+    before = audit.writes
+    ok = client.verify_signatures(
+        [stranger.public_key.bytes] * 4, [digest] * 4,
+        [stranger.sign(digest)] * 4,
+    )
+    assert ok == [True] * 4
+    assert audit.writes - before == 3 * 4
+
+
+def test_hello_ok_version_skew_old_client(tmp_path, signers):
+    """An old-protocol client (pre-r6: parses HELLO_OK only when exactly
+    16 bytes) still interoperates with the new server — it loses the
+    calibration (falls back to its own probe) but VERIFY/RESULT work."""
+    import struct
+
+    from mysticeti_tpu.verifier_service import (
+        T_HELLO,
+        T_HELLO_OK,
+        T_RESULT,
+        T_VERIFY,
+        _frame,
+    )
+
+    keys = [s.public_key.bytes for s in signers]
+
+    async def scenario(server):
+        pks, digests, sigs = _sigs(3, signers)
+        body = b"".join(
+            struct.pack("<H", keys.index(pk)) + d + s
+            for pk, d, s in zip(pks, digests, sigs)
+        )
+
+        def old_client():
+            import socket as _socket
+
+            conn = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            conn.settimeout(30)
+            conn.connect(server.socket_path)
+
+            def read_frame():  # the pre-r6 client's recv-loop parse
+                header = b""
+                while len(header) < 5:
+                    header += conn.recv(5 - len(header))
+                length, type_ = struct.unpack("<IB", header)
+                payload = b""
+                while len(payload) < length:
+                    payload += conn.recv(length - len(payload))
+                return type_, payload
+
+            try:
+                hello = struct.pack("<H", len(keys)) + b"".join(keys)
+                conn.sendall(_frame(T_HELLO, hello))
+                t1, reply = read_frame()
+                # Old parse rule: calibration iff len == 16.
+                calibration = (
+                    struct.unpack("<dd", reply) if len(reply) == 16 else None
+                )
+                conn.sendall(
+                    _frame(T_VERIFY, struct.pack("<II", 5, 3) + body)
+                )
+                t2, payload = read_frame()
+                return t1, len(reply), calibration, t2, list(payload[4:])
+            finally:
+                conn.close()
+
+        t1, reply_len, calibration, t2, oks = await asyncio.to_thread(
+            old_client
+        )
+        assert t1 == T_HELLO_OK and t2 == T_RESULT
+        assert reply_len > 16  # the new backend suffix is present...
+        assert calibration is None  # ...and the old client ignores it
+        assert oks == [1, 1, 1]
+
+    asyncio.run(_with_server(tmp_path, keys, CountingBackend(), scenario))
+
+
+def test_hello_ok_version_skew_old_server(tmp_path, signers, monkeypatch):
+    """A new client against an old server (16-byte HELLO_OK, no backend
+    suffix): calibration still seeds, the backend stays UNKNOWN, and the
+    hybrid therefore never pins (conservative default)."""
+    from mysticeti_tpu.verifier_service import VerifierServer as VS
+
+    keys = [s.public_key.bytes for s in signers]
+    # An empty backend suffix makes the payload exactly 16 bytes — byte-
+    # identical to the pre-r6 server's HELLO_OK.
+    monkeypatch.setattr(VS, "_resolved_backend", lambda self: "")
+
+    async def scenario(server):
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        await asyncio.to_thread(client.warmup)
+        assert client.dispatch_calibration() is not None
+        assert client.advertised_backend is None
+        pks, digests, sigs = _sigs(4, signers)
+        ok = await asyncio.to_thread(
+            client.verify_signatures, pks, digests, sigs
+        )
+        assert ok == [True] * 4
+
+    asyncio.run(_with_server(tmp_path, keys, CountingBackend(), scenario))
+
+
+def test_foreign_uid_peer_refused(tmp_path, signers, monkeypatch):
+    """VERDICT r5 #5: a connection from another local user is severed at
+    accept (SO_PEERCRED) before any frame is processed."""
+    import mysticeti_tpu.verifier_service as vs
+
+    keys = [s.public_key.bytes for s in signers]
+    monkeypatch.setattr(vs, "_peer_uid", lambda sock: os.getuid() + 1)
+
+    async def scenario(server):
+        client = RemoteSignatureVerifier(
+            socket_path=server.socket_path, committee_keys=keys
+        )
+        with pytest.raises((ConnectionError, OSError)):
+            await asyncio.to_thread(client.warmup)
+
+    asyncio.run(_with_server(tmp_path, keys, CountingBackend(), scenario))
+
+
+def test_socket_dir_hardening(tmp_path, signers, monkeypatch):
+    """The service refuses to bind into a directory another uid owns, and
+    tightens an owned-but-loose parent to 0700 (+ the socket to 0600)."""
+    import stat as stat_mod
+
+    keys = [s.public_key.bytes for s in signers]
+
+    async def refused():
+        server = VerifierServer(
+            str(tmp_path / "unowned" / "verifier.sock"), committee_keys=keys,
+            backend=CountingBackend(),
+        )
+        (tmp_path / "unowned").mkdir()
+        monkeypatch.setattr(os, "getuid", lambda: 0x5EED)
+        with pytest.raises(PermissionError, match="owned by uid"):
+            await server.start()
+
+    asyncio.run(refused())
+    monkeypatch.undo()
+
+    async def tightened():
+        sock_dir = tmp_path / "loose"
+        sock_dir.mkdir()
+        os.chmod(sock_dir, 0o755)
+        server = VerifierServer(
+            str(sock_dir / "verifier.sock"), committee_keys=keys,
+            backend=CountingBackend(),
+        )
+        await server.start()
+        try:
+            mode = stat_mod.S_IMODE(os.stat(sock_dir).st_mode)
+            assert mode == 0o700, oct(mode)
+            smode = stat_mod.S_IMODE(os.stat(server.socket_path).st_mode)
+            assert smode == 0o600, oct(smode)
+        finally:
+            await server.stop()
+
+    asyncio.run(tightened())
+
+
 def test_pipelined_hello_then_verify_waits_for_committee(tmp_path, signers):
     """A client that pipelines HELLO + VERIFY without waiting for HELLO_OK
     must still get correct verdicts: the verify may not EXECUTE before the

@@ -249,19 +249,18 @@ def main() -> None:
     os.environ["MYSTICETI_LEADER_TIMEOUT"] = "0.25"
 
     if any(v.startswith("tpu") for v in args.verifiers):
+        # Keys via mysticeti_tpu.crypto (pure-Python RFC 8032 fallback):
+        # hosts without the `cryptography` package still prewarm.
         print("prewarming kernel cache...", flush=True)
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PrivateKey,
-        )
-
+        from mysticeti_tpu import crypto
         from mysticeti_tpu.block_validator import TpuSignatureVerifier
 
-        keys = [
-            Ed25519PrivateKey.from_private_bytes(bytes([i] * 32))
+        signers = [
+            crypto.Signer.from_seed(bytes([i] * 32))
             for i in range(args.nodes)
         ]
         TpuSignatureVerifier(
-            committee_keys=[k.public_key().public_bytes_raw() for k in keys]
+            committee_keys=[s.public_key.bytes for s in signers]
         ).warmup()
 
     runs = []

@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""One-command chip-window capture: same-window tpu-vs-cpu fleet A/B.
+
+VERDICT r5 #3 prep: the first artifact where a tpu flavor beats cpu at
+fleet level needs a chip window — and chip windows are short, so the run
+must be a single command with zero setup decisions left.  This tool runs
+the VERIFICATION-BOUND fleet regime (the `CATCHUP_r05.json` configuration:
+small blocks to raise the block/signature rate, deep retain window, fast
+leader timeout) as back-to-back cpu and tpu-flavor max-load searches in one
+weather window, and records:
+
+  * the resolved jax platform ("cpu" = degraded, no chip; "tpu" = cashed
+    window) — so the artifact is honest about what it measured;
+  * per-probe hostmon weather + a same-window cpu reference probe
+    (inherited from tools/maxload_bench.py), so the A/B is self-contained;
+  * the headline ratio `tpu_peak / cpu_peak`.
+
+On the degraded (no-chip) backend this doubles as the zero-tax acceptance
+artifact: with backend-aware short-circuit routing the tpu flavor must
+price at >= 0.9x cpu (ISSUE 6 / VERDICT #4).
+
+Usage:
+  python tools/chip_window_ab.py --out MAXLOAD_TAX_r06.json
+  python tools/chip_window_ab.py --tpu-flavor tpu-agg --duration 30 \
+      --iterations 6 --out CHIPWINDOW_r06.json
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from maxload_bench import search_one  # noqa: E402 - sibling tool module
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--start-load", type=int, default=400)
+    parser.add_argument("--duration", type=float, default=20.0)
+    parser.add_argument("--iterations", type=int, default=5)
+    parser.add_argument("--max-block-tx", type=int, default=16)
+    parser.add_argument("--tpu-flavor", default="tpu",
+                        choices=["tpu", "tpu-only", "tpu-agg"])
+    parser.add_argument("--workdir", default="/tmp/mysticeti-chipwindow")
+    parser.add_argument("--out", default="CHIPWINDOW.json")
+    args = parser.parse_args()
+
+    # The verification-bound regime (catchup_bench.py's genesis-time env):
+    # small blocks raise the signature rate per committed tx, the retain
+    # window keeps sync streams deep, and the short leader timeout keeps
+    # stalls from hiding verification cost.
+    os.environ["MYSTICETI_MAX_BLOCK_TX"] = str(args.max_block_tx)
+    os.environ["MYSTICETI_RETAIN_ROUNDS"] = "100000"
+    os.environ["MYSTICETI_LEADER_TIMEOUT"] = "0.25"
+
+    # Prewarm the persistent kernel cache in THIS process and record what
+    # platform actually answered — the artifact's chip-window flag.
+    print("prewarming kernel cache...", flush=True)
+    from mysticeti_tpu import crypto
+    from mysticeti_tpu.block_validator import TpuSignatureVerifier
+
+    signers = [
+        crypto.Signer.from_seed(i.to_bytes(32, "little"))
+        for i in range(args.nodes)
+    ]
+    backend = TpuSignatureVerifier(
+        committee_keys=[s.public_key.bytes for s in signers]
+    )
+    backend.warmup()
+    platform = backend.resolved_backend()
+    print(f"resolved jax platform: {platform}", flush=True)
+
+    window_start = time.time()
+    runs = []
+    for verifier in ("cpu", args.tpu_flavor):
+        print(f"max-load search verifier={verifier}...", flush=True)
+        run = asyncio.run(
+            search_one(verifier, args.nodes, args.start_load, args.duration,
+                       args.iterations, args.workdir)
+        )
+        runs.append(run)
+        print(json.dumps(run), flush=True)
+
+    cpu_peak = runs[0]["peak_committed_tx_s"]
+    tpu_peak = runs[1]["peak_committed_tx_s"]
+    artifact = {
+        "metric": "same_window_tpu_vs_cpu_peak_committed_tx_s",
+        "resolved_platform": platform,
+        "chip_attached": platform != "cpu",
+        "regime": {
+            "max_block_tx": args.max_block_tx,
+            "retain_rounds": 100000,
+            "leader_timeout_s": 0.25,
+            "note": (
+                "verification-bound fleet shape (CATCHUP_r05 regime): "
+                "small blocks maximize signatures per committed tx"
+            ),
+        },
+        "window_utc": [round(window_start, 1), round(time.time(), 1)],
+        "cpu_peak_committed_tx_s": cpu_peak,
+        "tpu_peak_committed_tx_s": tpu_peak,
+        "tpu_over_cpu": round(tpu_peak / cpu_peak, 3) if cpu_peak else None,
+        "acceptance": (
+            "chip window: tpu_over_cpu > 1 proves VERDICT #3; degraded "
+            "(chip_attached=false): tpu_over_cpu >= 0.9 proves the "
+            "zero-tax data plane (VERDICT #4)"
+        ),
+        "runs": runs,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

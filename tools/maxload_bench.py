@@ -6,6 +6,13 @@ binary search (benchmark.rs:202-271 semantics — double until out-of-capacity,
 then bisect; out-of-capacity = avg latency > 5x previous or tps < 2/3
 offered) with the chosen --verifier and records every probe.
 
+Weather pinning (VERDICT r5 #8): the same box moves 20k->32k tx/s across
+hours, so a lone peak is not evidence.  Every probe embeds the hostmon
+weather summary AND wall-clock window, and every non-cpu run is followed
+immediately by a fixed-load cpu reference probe at that run's peak — so each
+artifact is a self-contained same-window A/B, and round-over-round deltas
+never need to reach across windows.
+
 Usage:
   python tools/maxload_bench.py --verifier cpu --out MAXLOAD_r03.json
   python tools/maxload_bench.py --verifiers cpu tpu --out MAXLOAD_TPU_r03.json
@@ -17,8 +24,53 @@ import asyncio
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _probe_dicts(collections) -> list:
+    probes = []
+    for c in collections:
+        probe = {
+            "offered_load_tx_s": c.parameters["load"],
+            "tps": round(c.aggregate_tps(), 1),
+            "avg_latency_s": round(c.aggregate_average_latency_s(), 4),
+            "stdev_latency_s": round(c.aggregate_stdev_latency_s(), 4),
+        }
+        host = c.host_summary()
+        if host is not None:
+            probe["host"] = host
+        probes.append(probe)
+    return probes
+
+
+async def run_fixed_probe(verifier: str, nodes: int, load: int,
+                          duration: float, workdir: str) -> dict:
+    """One fixed-load probe — the same-window reference leg of the A/B."""
+    from mysticeti_tpu.orchestrator.benchmark import LoadType, ParametersGenerator
+    from mysticeti_tpu.orchestrator.orchestrator import Orchestrator
+    from mysticeti_tpu.orchestrator.runner import LocalProcessRunner
+
+    runner = LocalProcessRunner(
+        os.path.join(workdir, f"fleet-ref-{verifier}"), verifier=verifier
+    )
+    generator = ParametersGenerator(
+        nodes, LoadType.fixed([load]), duration_s=duration
+    )
+    orch = Orchestrator(
+        runner,
+        generator,
+        results_dir=os.path.join(workdir, f"results-ref-{verifier}"),
+        scrape_interval_s=duration / 3,
+    )
+    started = time.time()
+    collections = await orch.run_benchmarks()
+    probes = _probe_dicts(collections)
+    probe = probes[0] if probes else {"error": "reference probe recorded nothing"}
+    probe["verifier"] = verifier
+    probe["window_utc"] = [round(started, 1), round(time.time(), 1)]
+    return probe
 
 
 async def search_one(verifier: str, nodes: int, start_load: int,
@@ -54,27 +106,16 @@ async def search_one(verifier: str, nodes: int, start_load: int,
         results_dir=os.path.join(workdir, f"results-{verifier}"),
         scrape_interval_s=duration / 3,
     )
+    started = time.time()
     collections = await orch.run_benchmarks()
-    probes = []
-    peak = 0.0
-    for c in collections:
-        tps = c.aggregate_tps()
-        peak = max(peak, tps)
-        probe = {
-            "offered_load_tx_s": c.parameters["load"],
-            "tps": round(tps, 1),
-            "avg_latency_s": round(c.aggregate_average_latency_s(), 4),
-            "stdev_latency_s": round(c.aggregate_stdev_latency_s(), 4),
-        }
-        host = c.host_summary()
-        if host is not None:
-            probe["host"] = host
-        probes.append(probe)
+    probes = _probe_dicts(collections)
+    peak = max((p["tps"] for p in probes), default=0.0)
     return {
         "verifier": verifier,
         "nodes": nodes,
         "max_sustainable_load_tx_s": generator.max_sustainable_load(),
         "peak_committed_tx_s": round(peak, 1),
+        "window_utc": [round(started, 1), round(time.time(), 1)],
         "probes": probes,
     }
 
@@ -96,20 +137,19 @@ def main() -> None:
     if any(v.startswith("tpu") for v in args.verifiers):
         # Compile every kernel flavor a node will touch into the persistent
         # cache once, in THIS process, so the fleet's per-node warmups are
-        # cache loads instead of four contending ~40 s compiles.
+        # cache loads instead of four contending ~40 s compiles.  Keys via
+        # mysticeti_tpu.crypto (pure-Python RFC 8032 fallback): hosts
+        # without the `cryptography` package still prewarm.
         print("prewarming kernel cache...", flush=True)
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PrivateKey,
-        )
-
+        from mysticeti_tpu import crypto
         from mysticeti_tpu.block_validator import TpuSignatureVerifier
 
-        keys = [
-            Ed25519PrivateKey.from_private_bytes(bytes([i] * 32))
+        signers = [
+            crypto.Signer.from_seed(bytes([i] * 32))
             for i in range(args.nodes)
         ]
         TpuSignatureVerifier(
-            committee_keys=[k.public_key().public_bytes_raw() for k in keys]
+            committee_keys=[s.public_key.bytes for s in signers]
         ).warmup()
 
     runs = []
@@ -119,6 +159,23 @@ def main() -> None:
             search_one(verifier, args.nodes, args.start_load, args.duration,
                        args.iterations, args.workdir)
         )
+        if verifier != "cpu":
+            # Same-window reference leg: a cpu probe at THIS run's peak,
+            # back-to-back so both legs share the box's current weather.
+            ref_load = int(run["peak_committed_tx_s"]) or args.start_load
+            print(
+                f"  same-window cpu reference probe at {ref_load} tx/s...",
+                flush=True,
+            )
+            run["cpu_reference_probe"] = asyncio.run(
+                run_fixed_probe("cpu", args.nodes, ref_load, args.duration,
+                                args.workdir)
+            )
+            ref_tps = run["cpu_reference_probe"].get("tps")
+            if ref_tps:
+                run["peak_vs_same_window_cpu"] = round(
+                    run["peak_committed_tx_s"] / ref_tps, 3
+                )
         runs.append(run)
         print(json.dumps(run), flush=True)
 
@@ -128,6 +185,11 @@ def main() -> None:
         "search_rule": (
             "double until out-of-capacity (latency>5x prev or tps<2/3 "
             "offered), then bisect (benchmark.rs:202-271 semantics)"
+        ),
+        "ab_rule": (
+            "every non-cpu run carries a cpu_reference_probe at its peak "
+            "load, run back-to-back in the same weather window; "
+            "peak_vs_same_window_cpu is the self-contained A/B ratio"
         ),
         "runs": runs,
     }
