@@ -28,6 +28,10 @@ stdlib-``ast`` rules, runnable as ``python -m mysticeti_tpu.analysis``:
   surface (``span``/``begin_span``/``end_span``/``record_span``) must come
   from the central registry ``spans.STAGES`` (a typo'd stage silently never
   matches its begin/end and vanishes from traces).
+* ``metrics-doc``      — every series registered in ``metrics.py`` must appear
+  in ``docs/observability.md`` (the series inventory of record), and every
+  ``mysticeti_*`` series the doc names must be registered — the inventory
+  cannot drift from the doc in either direction.
 
 Exit status: 0 = no new findings, 1 = new findings (or bad usage: 2).
 Deliberate exceptions carry an inline ``# lint: ignore[rule]`` suppression;
@@ -41,6 +45,8 @@ from .checker import (
     analyze_file,
     analyze_paths,
     analyze_source,
+    check_metrics_doc,
+    collect_metric_names,
     load_baseline,
     new_findings,
     write_baseline,
@@ -53,6 +59,8 @@ __all__ = [
     "analyze_file",
     "analyze_paths",
     "analyze_source",
+    "check_metrics_doc",
+    "collect_metric_names",
     "load_baseline",
     "main",
     "new_findings",

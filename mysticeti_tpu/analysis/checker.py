@@ -26,6 +26,7 @@ RULE_JIT_PURITY = "jit-purity"
 RULE_WALL_CLOCK = "wall-clock"
 RULE_METRICS_LABELS = "metrics-labels"
 RULE_SPAN_NAMES = "span-names"
+RULE_METRICS_DOC = "metrics-doc"
 
 RULES = (
     RULE_ASYNC_BLOCKING,
@@ -35,6 +36,7 @@ RULES = (
     RULE_WALL_CLOCK,
     RULE_METRICS_LABELS,
     RULE_SPAN_NAMES,
+    RULE_METRICS_DOC,
 )
 
 # -- rule configuration -------------------------------------------------------
@@ -255,6 +257,103 @@ def collect_metric_labels(tree: ast.Module) -> Dict[str, Tuple[str, ...]]:
             elif isinstance(target, ast.Name):
                 declared[target.id] = labels
     return declared
+
+
+def collect_metric_names(tree: ast.Module) -> Dict[str, int]:
+    """Registered series name -> registration line, from metrics.py's
+    ``counter/gauge/histogram("name", ...)`` idiom (and raw
+    prometheus_client constructors).  The benchmark-defining series are
+    registered through module-level string constants
+    (``counter(BENCHMARK_DURATION, ...)``) — those names resolve too."""
+    consts: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, str
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    consts[target.id] = node.value.value
+    names: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = None
+        if isinstance(func, ast.Name):
+            fname = func.id
+        elif isinstance(func, ast.Attribute):
+            fname = func.attr
+        if fname not in {
+            "counter", "gauge", "histogram", "Counter", "Gauge", "Histogram",
+        }:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            names.setdefault(first.value, node.lineno)
+        elif isinstance(first, ast.Name) and first.id in consts:
+            names.setdefault(consts[first.id], node.lineno)
+    return names
+
+
+# Series tokens in the observability doc: a prometheus metric name, possibly
+# wildcarded (``mysticeti_health_*`` names the family, not a series).  The
+# package itself shares the prefix — ``mysticeti_tpu`` (as in
+# ``python -m mysticeti_tpu`` or a module path) is never a series name.
+_DOC_SERIES_RE = re.compile(r"\bmysticeti_[a-z0-9_]+\b")
+_DOC_SERIES_NOT = frozenset({"mysticeti_tpu"})
+
+
+def check_metrics_doc(
+    metric_names: Dict[str, int],
+    metrics_path: str,
+    doc_text: str,
+    doc_path: str,
+) -> List[Finding]:
+    """The ``metrics-doc`` rule: every series registered in metrics.py must
+    appear in docs/observability.md (the doc is the series inventory of
+    record), and every ``mysticeti_*`` series the doc names must actually be
+    registered (no documenting what was renamed away).  Cross-file, so it
+    runs at the repo level rather than per-module."""
+    findings: List[Finding] = []
+    # Direction 1: registered but undocumented.  Token match (word
+    # boundaries) so ``latency_s`` does not ride on ``latency_squared_s``.
+    for name in sorted(metric_names):
+        if not re.search(rf"\b{re.escape(name)}\b", doc_text):
+            findings.append(
+                Finding(
+                    RULE_METRICS_DOC,
+                    metrics_path,
+                    metric_names[name],
+                    0,
+                    f"series '{name}' is registered in metrics.py but "
+                    f"missing from {doc_path} (the series inventory of "
+                    "record; add a row or drop the series)",
+                )
+            )
+    # Direction 2: documented mysticeti_* series that no longer exist.
+    registered = set(metric_names)
+    for lineno, line in enumerate(doc_text.splitlines(), start=1):
+        for match in _DOC_SERIES_RE.finditer(line):
+            token = match.group(0)
+            if token.endswith("_") or token in _DOC_SERIES_NOT:
+                continue  # family wildcard / the package's own name
+            if token not in registered:
+                findings.append(
+                    Finding(
+                        RULE_METRICS_DOC,
+                        doc_path,
+                        lineno,
+                        match.start(),
+                        f"doc names series '{token}' which is not "
+                        "registered in metrics.py (renamed or removed? "
+                        "update the inventory)",
+                    )
+                )
+    return findings
 
 
 def collect_span_stages(tree: ast.Module) -> Optional[Tuple[str, ...]]:
@@ -818,9 +917,11 @@ def analyze_paths(
     files = list(_iter_py_files(paths))
     metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None
     span_stages: Optional[Tuple[str, ...]] = None
+    metrics_py: Optional[str] = None
     for path in files:
         base = os.path.basename(path)
         if base == "metrics.py" and metric_labels is None:
+            metrics_py = path
             with open(path, "r", encoding="utf-8") as fh:
                 metric_labels = collect_metric_labels(ast.parse(fh.read()))
         elif base == "spans.py" and span_stages is None:
@@ -836,6 +937,27 @@ def analyze_paths(
                 span_stages=span_stages,
             )
         )
+    # Repo-level metrics-doc rule: runs whenever the scanned set contains
+    # the package metrics.py and the repo carries docs/observability.md
+    # (the series inventory of record).
+    if metrics_py is not None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(metrics_py)))
+        doc = os.path.join(repo, "docs", "observability.md")
+        if os.path.exists(doc):
+            with open(metrics_py, "r", encoding="utf-8") as fh:
+                metric_names = collect_metric_names(ast.parse(fh.read()))
+            with open(doc, "r", encoding="utf-8") as fh:
+                doc_text = fh.read()
+
+            def rel(path: str) -> str:
+                out = os.path.relpath(path, root) if root else path
+                return out.replace(os.sep, "/")
+
+            findings.extend(
+                check_metrics_doc(
+                    metric_names, rel(metrics_py), doc_text, rel(doc)
+                )
+            )
     return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 

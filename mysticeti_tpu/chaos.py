@@ -51,6 +51,7 @@ from .commit_observer import TestCommitObserver
 from .committee import Committee
 from .config import Parameters
 from .core import Core, CoreOptions
+from .health import FleetHealthMonitor, HealthProbe, SLOThresholds
 from .metrics import Metrics
 from .net_sync import NetworkSyncer
 from .simulated_network import SimulatedNetwork
@@ -392,6 +393,8 @@ class ChaosSimHarness:
         committee: Optional[Committee] = None,
         verifier_factory=None,
         with_metrics: bool = False,
+        slo: Optional[SLOThresholds] = None,
+        health_interval_s: float = 1.0,
     ) -> None:
         self.n = n
         self.wal_dir = wal_dir
@@ -411,6 +414,23 @@ class ChaosSimHarness:
         self.sim_net = SimulatedNetwork(n)
         self.nodes: List[Optional[NetworkSyncer]] = [None] * n
         self.down: Set[int] = set()
+        # Health plane: one probe per authority, SURVIVING restarts (rate
+        # state and the alert stream span a node's whole life); a central
+        # loop-clocked monitor samples them so same-seed runs produce a
+        # byte-identical health timeline.
+        self.probes: Dict[int, HealthProbe] = (
+            {
+                a: HealthProbe(a, n, metrics=self.metrics[a], slo=slo)
+                for a in range(n)
+            }
+            if slo is not None
+            else {}
+        )
+        self.health_monitor: Optional[FleetHealthMonitor] = (
+            FleetHealthMonitor(self.probes.get, n, interval_s=health_interval_s)
+            if slo is not None
+            else None
+        )
 
     def _wal_path(self, authority: int) -> str:
         return os.path.join(self.wal_dir, f"wal-{authority}")
@@ -451,7 +471,7 @@ class ChaosSimHarness:
             if self.verifier_factory is not None
             else None
         )
-        return NetworkSyncer(
+        node = NetworkSyncer(
             core,
             observer,
             _SimNodeNetwork(self.sim_net.node_connections[authority]),
@@ -459,6 +479,15 @@ class ChaosSimHarness:
             block_verifier=verifier,
             metrics=self.metrics[authority],
         )
+        probe = self.probes.get(authority)
+        if probe is not None:
+            probe.attach(
+                core=core,
+                net_syncer=node,
+                block_verifier=verifier,
+                commit_observer=observer,
+            )
+        return node
 
     async def start(self) -> None:
         for authority in range(self.n):
@@ -466,11 +495,16 @@ class ChaosSimHarness:
             self.nodes[authority] = node
             await node.start()
         await self.sim_net.connect_all()
+        if self.health_monitor is not None:
+            self.health_monitor.start()
 
     async def crash(self, authority: int, torn_tail_bytes: int = 0) -> None:
         node = self.nodes[authority]
         assert node is not None, f"authority {authority} is already down"
         self.down.add(authority)
+        probe = self.probes.get(authority)
+        if probe is not None:
+            probe.detach()  # sampled as {"down": true} until restart
         self.sim_net.crash(authority)
         await node.stop()
         # Close the WAL cleanly (drains the async appender): the baseline
@@ -496,6 +530,8 @@ class ChaosSimHarness:
         return node
 
     async def stop(self) -> None:
+        if self.health_monitor is not None:
+            self.health_monitor.stop()
         for node in self.nodes:
             if node is None:
                 continue
@@ -677,6 +713,12 @@ class ChaosReport:
     schedule_bytes: bytes
     fault_counts: Dict[str, int]
     crash_events: List[dict]
+    # Health plane (present when the scenario ran with an SLO config): the
+    # deterministic fleet timeline, its canonical bytes, and every watchdog
+    # alert — the run ships with its own diagnosis.
+    health_timeline: List[dict] = field(default_factory=list)
+    health_timeline_bytes: bytes = b""
+    slo_alerts: List[dict] = field(default_factory=list)
 
     def schedule_digest(self) -> str:
         return hashlib.sha256(self.fault_log_bytes).hexdigest()
@@ -691,6 +733,7 @@ def run_chaos_sim(
     verifier_factory=None,
     with_metrics: bool = False,
     extra_fault=None,
+    slo: Optional[SLOThresholds] = None,
 ) -> Tuple[ChaosReport, ChaosSimHarness]:
     """Run one chaos scenario to completion on a fresh DeterministicLoop.
 
@@ -708,6 +751,7 @@ def run_chaos_sim(
         parameters=parameters,
         verifier_factory=verifier_factory,
         with_metrics=with_metrics,
+        slo=slo,
     )
     engine = ChaosEngine(harness, plan)
 
@@ -725,6 +769,7 @@ def run_chaos_sim(
             extra.cancel()
         await harness.stop()
         harness.checker.check()
+        monitor = harness.health_monitor
         return ChaosReport(
             sequences=harness.sequences(),
             fault_log=engine.fault_log,
@@ -732,6 +777,11 @@ def run_chaos_sim(
             schedule_bytes=schedule_bytes(plan),
             fault_counts=engine.fault_counts(),
             crash_events=[e for e in engine.fault_log if e["kind"] == "crash"],
+            health_timeline=monitor.timeline if monitor else [],
+            health_timeline_bytes=(
+                monitor.timeline_bytes() if monitor else b""
+            ),
+            slo_alerts=monitor.alert_stream() if monitor else [],
         )
 
     return run_simulation(main(), seed=plan.seed), harness

@@ -107,16 +107,36 @@ async def run_node(
         tps=tps,
         verifier=verifier,
     )
+    # Orderly shutdown on SIGTERM (fleet runners/operators stopping a node):
+    # flush the span-trace tail and the last metrics window through
+    # Validator.stop instead of dying mid-flush — only SIGKILL loses tails.
+    import signal as _signal
+
+    term = asyncio.Event()
+    loop = asyncio.get_running_loop()
     try:
-        if exit_after > 0:
-            try:
-                await asyncio.wait_for(
-                    validator.network_syncer.await_completion(), exit_after
-                )
-            except asyncio.TimeoutError:
-                await validator.stop()  # clean WAL close + network shutdown
+        loop.add_signal_handler(_signal.SIGTERM, term.set)
+    except (NotImplementedError, RuntimeError):  # non-unix / nested loop
+        pass
+    try:
+        completion = asyncio.ensure_future(
+            validator.network_syncer.await_completion()
+        )
+        term_wait = asyncio.ensure_future(term.wait())
+        timeout = exit_after if exit_after > 0 else None
+        done, pending = await asyncio.wait(
+            (completion, term_wait),
+            timeout=timeout,
+            return_when=asyncio.FIRST_COMPLETED,
+        )
+        for task in pending:
+            task.cancel()
+        if completion in done:
+            completion.result()  # a node that died with an error must raise
         else:
-            await validator.network_syncer.await_completion()
+            # Timed exit or SIGTERM: clean WAL close + network shutdown +
+            # telemetry tail flush.
+            await validator.stop()
     finally:
         if profiler is not None:
             profiler.disable()
@@ -234,6 +254,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="WAL directory (default: a fresh temp dir)")
     ch.add_argument("--dump-schedule", action="store_true",
                     help="print the resolved fault schedule and exit")
+    ch.add_argument("--slo", default=None,
+                    help="SLOThresholds JSON path (default: built-in chaos "
+                    "thresholds); the run's health timeline + alerts ride "
+                    "in the report")
+    ch.add_argument("--health-out", default=None,
+                    help="write the deterministic health timeline + SLO "
+                    "alert stream as JSON")
 
     vs = sub.add_parser(
         "verifier-service",
@@ -328,6 +355,7 @@ def run_chaos(args) -> int:
     deterministic simulator, print per-node commit progress, the injected
     fault tally, and the fault-schedule digest (byte-identical across runs
     of the same plan), and fail loudly on any commit-safety violation."""
+    import json
     import tempfile
 
     from .chaos import (
@@ -343,11 +371,23 @@ def run_chaos(args) -> int:
         for event in resolve_schedule(plan):
             print(event)
         return 0
+    from .health import SLOThresholds
+
+    if args.slo:
+        with open(args.slo, "r", encoding="utf-8") as f:
+            slo = SLOThresholds.from_dict(json.load(f))
+    else:
+        slo = SLOThresholds(
+            max_round_stall_s=8.0,
+            max_commit_stall_s=10.0,
+            max_authority_lag_rounds=15,
+        )
     wal_dir = args.working_directory or tempfile.mkdtemp(prefix="chaos-")
     os.makedirs(wal_dir, exist_ok=True)
     try:
         report, _harness = run_chaos_sim(
-            plan, args.nodes, args.duration, wal_dir, with_metrics=True
+            plan, args.nodes, args.duration, wal_dir, with_metrics=True,
+            slo=slo,
         )
     except SafetyViolation as exc:
         print(f"SAFETY VIOLATION: {exc}")
@@ -359,6 +399,28 @@ def run_chaos(args) -> int:
     )
     print(f"faults injected: {faults or 'none'}")
     print(f"fault schedule digest: {report.schedule_digest()}")
+    for alert in report.slo_alerts:
+        who = "node" if alert["authority"] is None else f"A{alert['authority']}"
+        print(
+            f"SLO alert t={alert['t']:.1f}s {alert['kind']} [{alert['stage']}]"
+            f" {who} (observed by A{alert['observer']}): {alert['detail']}"
+        )
+    print(
+        f"health: {len(report.slo_alerts)} SLO alert(s) over "
+        f"{len(report.health_timeline)} timeline sample(s)"
+    )
+    if args.health_out:
+        with open(args.health_out, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "slo": slo.to_dict(),
+                    "timeline": report.health_timeline,
+                    "alerts": report.slo_alerts,
+                },
+                f, indent=1,
+            )
+            f.write("\n")
+        print(f"health timeline written to {args.health_out}")
     print("safety: OK (identical committed prefixes on all nodes)")
     return 0
 
@@ -375,8 +437,14 @@ def run_fleet(args) -> int:
     pool = args.hosts if args.hosts is not None else settings.hosts
     if settings.provider != "static":
         provider = settings.make_provider(state_path=args.state)
-        if settings.provider == "rest" and args.action == "deploy" and not args.count:
-            raise SystemExit("rest provider: `fleet deploy` requires --count")
+        if (
+            settings.provider in ("rest", "aws")
+            and args.action == "deploy"
+            and not args.count
+        ):
+            raise SystemExit(
+                f"{settings.provider} provider: `fleet deploy` requires --count"
+            )
         # The ssh pool comes from the PROVIDER's live instances (a cloud
         # fleet has no static hosts list); resolved per-action below since
         # listing is async.
@@ -398,7 +466,7 @@ def run_fleet(args) -> int:
     )
 
     async def dispatch() -> None:
-        if settings.provider == "rest" and tb.ssh is None:
+        if settings.provider in ("rest", "aws") and tb.ssh is None:
             hosts = [i.host for i in await provider.list_instances() if i.host]
             if hosts:
                 tb.ssh = SshManager(hosts)

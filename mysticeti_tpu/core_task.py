@@ -155,11 +155,15 @@ class CoreTaskDispatcher:
         return await self._call(self.syncer.add_blocks, list(blocks), connected)
 
     async def force_new_block(
-        self, round_: RoundNumber, connected: AuthoritySet
+        self, round_: RoundNumber, connected: AuthoritySet,
+        genesis: bool = False,
     ) -> bool:
-        # internal: driven by the leader-timeout task, not a remote peer.
+        # internal: driven by the leader-timeout task (or the boot-time
+        # genesis kick, which must not be attributed as a leader timeout),
+        # not a remote peer.
         return await self._call(
-            self.syncer.force_new_block, round_, connected, internal=True
+            self.syncer.force_new_block, round_, connected, genesis,
+            internal=True,
         )
 
     async def cleanup(self) -> None:

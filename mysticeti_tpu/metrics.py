@@ -324,6 +324,72 @@ class Metrics:
             "verifier-service client connections torn down and retried",
         )
 
+        # Fleet health plane (health.py): consensus-level health signals
+        # derived from state the node already has, refreshed by the
+        # HealthProbe sampler; the same probe serves the /health diagnosis
+        # document next to /healthz.
+        self.mysticeti_health_round_advance_rate = gauge(
+            "mysticeti_health_round_advance_rate",
+            "threshold-clock rounds advanced per second (EMA over probe "
+            "samples)",
+        )
+        self.mysticeti_health_commit_rate = gauge(
+            "mysticeti_health_commit_rate",
+            "committed sub-dags per second (EMA over probe samples)",
+        )
+        self.mysticeti_health_frontier_skew_rounds = gauge(
+            "mysticeti_health_frontier_skew_rounds",
+            "DAG frontier skew: max peer round seen minus own round "
+            "(positive = this node is behind the fleet)",
+        )
+        self.mysticeti_health_authority_lag_rounds = gauge(
+            "mysticeti_health_authority_lag_rounds",
+            "per-authority frontier lag: own round minus the authority's "
+            "last block round seen here (a growing lag names the straggler)",
+            labels=("authority",),
+        )
+        self.mysticeti_health_leader_timeout_total = counter(
+            "mysticeti_health_leader_timeout_total",
+            "leader timeouts attributed to the authority whose leader slot "
+            "stalled the round",
+            labels=("authority",),
+        )
+        self.mysticeti_health_verifier_breaker_open = gauge(
+            "mysticeti_health_verifier_breaker_open",
+            "1 while the hybrid verifier circuit breaker is open (degraded "
+            "to the CPU oracle)",
+        )
+        self.mysticeti_health_verifier_pinned = gauge(
+            "mysticeti_health_verifier_pinned",
+            "1 while short-circuit routing is pinned to the in-process "
+            "oracle (service advertised a CPU-only backend)",
+        )
+        self.mysticeti_health_wal_backlog = gauge(
+            "mysticeti_health_wal_backlog",
+            "1 while acknowledged WAL appends are still queued in process "
+            "memory (the async drain is behind)",
+        )
+        self.mysticeti_health_status = gauge(
+            "mysticeti_health_status",
+            "1 when no SLO alert is firing, 0 while degraded (the /health "
+            "readiness verdict)",
+        )
+        self.mysticeti_health_slo_alerts_total = counter(
+            "mysticeti_health_slo_alerts_total",
+            "SLO watchdog alerts raised, named by kind, the indicted "
+            "authority (empty = whole node), and the pipeline stage",
+            labels=("kind", "authority", "stage"),
+        )
+        self.commit_critical_path_seconds = histogram(
+            "commit_critical_path_seconds",
+            "per committed leader: time each pipeline stage spent on the "
+            "receive->verify->dag_add->proposal_wait->commit->finalize "
+            "critical path (requires span tracing; see health.py)",
+            labels=("stage",),
+            buckets=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0],
+        )
+
         # Robustness / chaos engineering.
         self.crash_recovery_total = counter(
             "crash_recovery_total",
@@ -442,14 +508,26 @@ class MetricReporter:
             await asyncio.sleep(self.interval_s)
             self.metrics.report_precise()
 
-    def stop(self) -> None:
+    def stop(self, final: bool = False) -> None:
+        """Cancel the periodic task; ``final=True`` publishes one last
+        percentile sweep so an orderly shutdown never loses the window that
+        accumulated since the previous 60 s tick (short runs lose their
+        ENTIRE sample set without it)."""
         if self._task is not None:
             self._task.cancel()
+        if final:
+            self.metrics.report_precise()
 
 
-async def serve_metrics(metrics: Metrics, host: str, port: int):
+async def serve_metrics(metrics: Metrics, host: str, port: int,
+                        health_probe=None):
     """Minimal asyncio HTTP endpoint (prometheus.rs:31-49): ``/metrics`` for
-    the scraper plus ``/healthz`` (200 + uptime) for liveness probes."""
+    the scraper, ``/healthz`` (200 + uptime) for liveness probes, and — when
+    a :class:`~mysticeti_tpu.health.HealthProbe` is wired — ``/health``, the
+    readiness/diagnosis JSON document (503 while an SLO alert is firing, so
+    the route doubles as a readiness gate)."""
+    import json as _json
+
     started = time.monotonic()
 
     async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -461,19 +539,27 @@ async def serve_metrics(metrics: Metrics, host: str, port: int):
                     break
             parts = request.split()
             path = parts[1].decode(errors="replace") if len(parts) > 1 else "/"
+            status = b"200 OK"
             if path.split("?", 1)[0] == "/healthz":
                 body = (
                     '{"status":"ok","uptime_s":%.3f}\n'
                     % (time.monotonic() - started)
                 ).encode()
                 content_type = b"application/json"
+            elif path.split("?", 1)[0] == "/health" and health_probe is not None:
+                doc = health_probe.diagnosis()
+                body = (_json.dumps(doc, sort_keys=True) + "\n").encode()
+                content_type = b"application/json"
+                if doc.get("status") != "ok":
+                    status = b"503 Service Unavailable"
             else:
                 # Anything else serves the scrape (back-compat: the
                 # orchestrator scraper GETs /metrics).
                 body = metrics.expose()
                 content_type = b"text/plain; version=0.0.4"
             writer.write(
-                b"HTTP/1.1 200 OK\r\nContent-Type: " + content_type + b"\r\n"
+                b"HTTP/1.1 " + status + b"\r\nContent-Type: " + content_type
+                + b"\r\n"
                 + f"Content-Length: {len(body)}\r\n\r\n".encode()
                 + body
             )

@@ -142,7 +142,9 @@ class NetworkSyncer:
         self.dispatcher.start()
         self.connected_authorities.insert(self.core.authority)
         # Initial proposal attempt (validator genesis kick, net_sync.rs:97).
-        await self.dispatcher.force_new_block(1, self.connected_authorities.copy())
+        await self.dispatcher.force_new_block(
+            1, self.connected_authorities.copy(), genesis=True
+        )
         self._tasks.append(spawn_logged(self._accept_loop(), log))
         self._tasks.append(spawn_logged(self._leader_timeout_task(), log))
         self._tasks.append(spawn_logged(self._cleanup_task(), log))

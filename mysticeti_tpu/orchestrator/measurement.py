@@ -35,6 +35,22 @@ def _labels(raw: Optional[str]) -> Dict[str, str]:
     return out
 
 
+def iter_series(text: str):
+    """Parse prometheus exposition text into ``(name, labels, value)``
+    tuples — the one scrape parser shared by the benchmark measurements
+    below and the fleet health plane (``health.py``, ``tools/fleetmon.py``)."""
+    for line in text.splitlines():
+        match = _RE_LINE.match(line)
+        if not match:
+            continue
+        name, raw_labels, raw_value = match.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        yield name, _labels(raw_labels), value
+
+
 @dataclass
 class Measurement:
     """One scrape's benchmark numbers for one workload label."""
@@ -49,16 +65,7 @@ class Measurement:
     @classmethod
     def from_prometheus(cls, text: str, workload: str = "shared") -> "Measurement":
         m = cls(timestamp_s=time.time())
-        for line in text.splitlines():
-            match = _RE_LINE.match(line)
-            if not match:
-                continue
-            name, raw_labels, raw_value = match.groups()
-            labels = _labels(raw_labels)
-            try:
-                value = float(raw_value)
-            except ValueError:
-                continue
+        for name, labels, value in iter_series(text):
             if name == "benchmark_duration_total" or name == "benchmark_duration":
                 m.benchmark_duration_s = value
             elif labels.get("workload") != workload:
@@ -115,12 +122,19 @@ class MeasurementsCollection:
         # sample per scrape tick, so saturation is attributable to the host
         # (core-steal between co-located validators) and not just the node.
         self.host_samples: List[dict] = []
+        # Fleet health timeline (health.cluster_snapshot per scrape tick):
+        # every perf artifact ships with its own diagnosis — quorum
+        # participation, stragglers, commit skew, SLO alerts.
+        self.health_samples: List[dict] = []
 
     def add(self, scraper_id: str, measurement: Measurement) -> None:
         self.scrapers.setdefault(scraper_id, []).append(measurement)
 
     def add_host_sample(self, sample: dict) -> None:
         self.host_samples.append(sample)
+
+    def add_health_sample(self, sample: dict) -> None:
+        self.health_samples.append(sample)
 
     def _last_measurements(self) -> List[Measurement]:
         return [series[-1] for series in self.scrapers.values() if series]
@@ -206,6 +220,41 @@ class MeasurementsCollection:
             )
         return out
 
+    def health_summary(self) -> Optional[dict]:
+        """Aggregate the health timeline: the run's worst moments plus the
+        final snapshot — enough for an artifact reader to judge whether a
+        perf number was taken on a healthy fleet without replaying the
+        whole timeline.  None when the health plane never sampled."""
+        samples = self.health_samples
+        if not samples:
+            return None
+        last = samples[-1]
+        alert_totals: Dict[str, float] = dict(
+            last.get("slo_alert_totals") or {}
+        )
+        return {
+            "samples": len(samples),
+            "final_status": last.get("status"),
+            "quorum_participation_min": min(
+                s.get("quorum_participation", 0.0) for s in samples
+            ),
+            "commit_skew_rounds_max": max(
+                s.get("commit_skew_rounds", 0) for s in samples
+            ),
+            "unreachable_ticks": sum(
+                1 for s in samples if s.get("unreachable")
+            ),
+            "slo_alert_totals": alert_totals,
+            "worst_straggler": max(
+                (
+                    (lag, a)
+                    for s in samples
+                    for a, lag in (s.get("straggler_score") or {}).items()
+                ),
+                default=None,
+            ),
+        }
+
     def save(self, path: str) -> None:
         data = {
             "parameters": self.parameters,
@@ -213,6 +262,7 @@ class MeasurementsCollection:
                 k: [m.to_dict() for m in v] for k, v in self.scrapers.items()
             },
             "host_samples": self.host_samples,
+            "health_samples": self.health_samples,
         }
         with open(path, "w") as f:
             json.dump(data, f, indent=1)
@@ -225,6 +275,7 @@ class MeasurementsCollection:
         for k, series in raw.get("scrapers", {}).items():
             c.scrapers[k] = [Measurement.from_dict(m) for m in series]
         c.host_samples = raw.get("host_samples", [])
+        c.health_samples = raw.get("health_samples", [])
         return c
 
     def display_summary(self) -> str:
@@ -241,5 +292,14 @@ class MeasurementsCollection:
             lines.append(
                 f" host cpu:      {host['cpu_pct_avg']:.0f}% avg /"
                 f" {host['cpu_pct_max']:.0f}% max"
+            )
+        health = self.health_summary()
+        if health is not None:
+            alerts = sum(health["slo_alert_totals"].values())
+            lines.append(
+                f" fleet health:  {health['final_status']} "
+                f"(participation >= {health['quorum_participation_min']:.2f},"
+                f" commit skew <= {health['commit_skew_rounds_max']},"
+                f" {alerts:.0f} SLO alert(s))"
             )
         return "\n".join(lines)

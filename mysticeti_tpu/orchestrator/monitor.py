@@ -67,6 +67,16 @@ def grafana_dashboard() -> dict:
                   "histogram_quantile(0.9, rate(verify_batch_size_bucket[1m]))", 16),
             panel(5, "peer RTT p90",
                   "histogram_quantile(0.9, rate(connection_latency_bucket[1m]))", 16),
+            # Fleet health plane (health.py): the "why was it slow" row.
+            panel(6, "health: commit rate / round advance",
+                  "mysticeti_health_commit_rate", 24),
+            panel(7, "health: per-authority frontier lag",
+                  "mysticeti_health_authority_lag_rounds", 24),
+            panel(8, "health: SLO alerts by kind",
+                  "rate(mysticeti_health_slo_alerts_total[1m])", 32),
+            panel(9, "commit critical path p90 by stage",
+                  "histogram_quantile(0.9, "
+                  "rate(commit_critical_path_seconds_bucket[1m]))", 32),
         ],
     }
 

@@ -12,6 +12,7 @@ import asyncio
 import os
 from typing import List, Optional
 
+from ..health import cluster_snapshot_from_texts
 from .benchmark import BenchmarkParameters, ParametersGenerator
 from .faults import CrashRecoverySchedule
 from .measurement import Measurement, MeasurementsCollection
@@ -64,8 +65,10 @@ class Orchestrator:
             await asyncio.sleep(step)
             elapsed += step
             # Scrape every node (orchestrator.rs:523-541).
+            texts = {}
             for authority in range(parameters.nodes):
                 text = await self.runner.scrape(authority)
+                texts[str(authority)] = text
                 if text is not None:
                     collection.add(
                         str(authority),
@@ -76,6 +79,18 @@ class Orchestrator:
             host = await self.runner.host_sample()
             if host is not None:
                 collection.add_host_sample(host)
+            # Fleet health snapshot from the same scrape (health.py): the
+            # run's artifact carries its own diagnosis — which authority
+            # straggled, how far commits skewed, whether SLO alerts fired.
+            snapshot = cluster_snapshot_from_texts(texts, parameters.nodes)
+            snapshot["t"] = round(elapsed, 3)
+            if host is not None:
+                snapshot["weather"] = {
+                    k: host[k]
+                    for k in ("cpu_pct", "load_1m", "mem_available_mb")
+                    if k in host
+                }
+            collection.add_health_sample(snapshot)
             # Fault schedule (orchestrator.rs:543-583).
             if (
                 parameters.faults.kind != "none"

@@ -12,7 +12,7 @@ import dataclasses
 import json
 import os
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 @dataclass
@@ -28,23 +28,48 @@ class Settings:
     verifier: str = "cpu"
     # Testbed provisioning (settings.rs cloud_provider/token_file): "static"
     # claims hosts from ``hosts``; "rest" provisions via the JSON-REST cloud
-    # client (providers.py).  The API token is read from the env var named
-    # by ``provider_token_env`` so checked-in settings never carry secrets.
-    provider: str = "static"  # "static" | "rest"
+    # client; "aws" via the EC2-surface client (providers.py — regions×AMIs,
+    # security group, EC2 lifecycle states).  The API token is read from the
+    # env var named by ``provider_token_env`` so checked-in settings never
+    # carry secrets.
+    provider: str = "static"  # "static" | "rest" | "aws"
     provider_base_url: str = ""
     provider_token_env: str = "CLOUD_API_TOKEN"
     provider_region: str = "ewr"
     provider_plan: str = "vc2-16c-64gb"
+    # aws provider: region -> AMI map (settings.rs carries the same pairing
+    # for its aws testbeds), instance type, and the ensured security group.
+    provider_amis: Dict[str, str] = field(default_factory=dict)
+    provider_instance_type: str = "m5d.8xlarge"
+    provider_security_group: str = "mysticeti-tpu"
 
     def validate(self) -> None:
         if self.runner not in ("local", "ssh"):
             raise ValueError(f"unknown runner {self.runner!r}")
         if self.runner == "ssh" and not self.hosts:
             raise ValueError("ssh runner requires at least one host")
-        if self.provider not in ("static", "rest"):
+        if self.provider not in ("static", "rest", "aws"):
             raise ValueError(f"unknown provider {self.provider!r}")
-        if self.provider == "rest" and not self.provider_base_url:
-            raise ValueError("rest provider requires provider_base_url")
+        if self.provider in ("rest", "aws") and not self.provider_base_url:
+            raise ValueError(
+                f"{self.provider} provider requires provider_base_url"
+            )
+        if self.provider == "aws" and not self.provider_amis:
+            raise ValueError(
+                "aws provider requires provider_amis (region -> AMI)"
+            )
+        if (
+            self.provider == "aws"
+            and self.provider_region != "ewr"  # the untouched vultr default
+            and self.provider_region not in self.provider_amis
+        ):
+            # An explicitly-set region with no AMI would silently fall back
+            # to the first configured region — a whole fleet in the wrong
+            # continent.  Fail the config loudly instead.
+            raise ValueError(
+                f"provider_region {self.provider_region!r} has no entry in "
+                f"provider_amis (configured: {sorted(self.provider_amis)})"
+            )
 
     def make_provider(self, state_path: Optional[str] = None,
                       transport=None):
@@ -58,6 +83,22 @@ class Settings:
                 token=os.environ.get(self.provider_token_env, ""),
                 region=self.provider_region,
                 plan=self.provider_plan,
+                transport=transport,
+            )
+        if self.provider == "aws":
+            from .providers import Ec2Provider
+
+            return Ec2Provider(
+                self.provider_base_url,
+                token=os.environ.get(self.provider_token_env, ""),
+                amis=self.provider_amis,
+                instance_type=self.provider_instance_type,
+                security_group=self.provider_security_group,
+                default_region=(
+                    self.provider_region
+                    if self.provider_region in self.provider_amis
+                    else None
+                ),
                 transport=transport,
             )
         from .testbed import StaticProvider

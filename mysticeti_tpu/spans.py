@@ -113,7 +113,17 @@ class SpanTracer:
         self._events: List[Tuple[str, str, Optional[int], float, float]] = []
         # Open spans: (stage, ref, authority) -> t0.
         self._open: Dict[Tuple[str, object, Optional[int]], float] = {}
+        # Live subscribers called with (stage, ref, authority, t0, t1) for
+        # every COMPLETED span (the critical-path analyzer in health.py).
+        # Called outside the lock, on the recording thread; sinks must be
+        # cheap and never raise.
+        self._sinks: List = []
         self._lock = threading.Lock()
+        # Serializes write(): the periodic flusher thread and an orderly-
+        # shutdown flush_active() both target the same <path>.tmp — unlocked,
+        # one thread's os.replace could publish the file while the other is
+        # still appending to the fd, interleaving two JSON documents.
+        self._write_lock = threading.Lock()
         self.dropped = 0
         self.flush_path = flush_path
         self.flush_every_s = flush_every_s
@@ -126,6 +136,18 @@ class SpanTracer:
     def now() -> float:
         """The runtime clock: virtual under simulation, monotonic otherwise."""
         return runtime_now()
+
+    # -- live span stream --
+
+    def add_sink(self, sink) -> None:
+        """Subscribe to the completed-span stream: ``sink(stage, ref,
+        authority, t0, t1)`` per recorded span, event-cap independent (a
+        dropped trace event still feeds attribution)."""
+        self._sinks.append(sink)
+
+    def _notify(self, stage, ref, authority, t0, t1) -> None:
+        for sink in self._sinks:
+            sink(stage, ref, authority, t0, t1)
 
     # -- recording --
 
@@ -142,6 +164,7 @@ class SpanTracer:
             authority = current_authority.get()
         if t1 is None:
             t1 = runtime_now()
+        self._notify(stage, ref, authority, t0, t1)
         with self._lock:
             if len(self._events) >= self.MAX_EVENTS:
                 self.dropped += 1
@@ -182,16 +205,17 @@ class SpanTracer:
         if authority is None:
             authority = current_authority.get()
         key = (stage, ref, authority)
+        if t is None:
+            t = runtime_now()
         with self._lock:
             t0 = self._open.pop(key, None)
             if t0 is None:
                 return
             if len(self._events) >= self.MAX_EVENTS:
                 self.dropped += 1
-                return
-            if t is None:
-                t = runtime_now()
-            self._events.append((stage, format_ref(ref), authority, t0, t))
+            else:
+                self._events.append((stage, format_ref(ref), authority, t0, t))
+        self._notify(stage, ref, authority, t0, t)
 
     @contextmanager
     def span(self, stage: str, ref, authority: Optional[int] = None):
@@ -260,14 +284,17 @@ class SpanTracer:
 
     def write(self, path: str) -> None:
         """Atomic write (tmp + rename): a SIGKILL landing mid-flush must not
-        replace the previous complete snapshot with a truncated file."""
+        replace the previous complete snapshot with a truncated file.
+        Thread-safe: the flusher thread and shutdown flushes share the tmp."""
         tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                self.chrome_trace(), f, sort_keys=True, separators=(",", ":")
-            )
-            f.write("\n")
-        os.replace(tmp, path)
+        with self._write_lock:
+            with open(tmp, "w") as f:
+                json.dump(
+                    self.chrome_trace(), f, sort_keys=True,
+                    separators=(",", ":"),
+                )
+                f.write("\n")
+            os.replace(tmp, path)
 
     # -- periodic flush (survive SIGKILL, like profiling.SamplingProfiler) --
 
@@ -320,6 +347,20 @@ def start_from_env() -> Optional[SpanTracer]:
     path = path.replace("%p", str(os.getpid()))
     _active = SpanTracer(flush_path=path).start()
     return _active
+
+
+def flush_active() -> None:
+    """Write the live tracer's current snapshot NOW (orderly-shutdown hook:
+    ``Validator.stop`` calls this so short runs keep the span tail instead
+    of losing everything since the last periodic flush).  The tracer stays
+    active — stop_from_env still finalizes it."""
+    tracer = _active
+    if tracer is None or not tracer.flush_path:
+        return
+    try:
+        tracer.write(tracer.flush_path)
+    except OSError:
+        pass
 
 
 def stop_from_env() -> None:

@@ -63,11 +63,23 @@ class Syncer:
         return missing_references
 
     def force_new_block(
-        self, round_: RoundNumber, connected_authorities: AuthoritySet
+        self, round_: RoundNumber, connected_authorities: AuthoritySet,
+        genesis: bool = False,
     ) -> bool:
         if self.core.last_proposed() < round_:
             if self.metrics is not None:
                 self.metrics.leader_timeout_total.inc()
+                if not genesis:
+                    # Attribute the stall: the timeout fired because the
+                    # leader(s) of the round being abandoned never showed —
+                    # counted per authority so fleet health can name the
+                    # validator whose slots keep timing out.  The boot-time
+                    # genesis kick reaches here too and indicts nobody.
+                    for leader in self.core.leaders(max(1, round_ - 1)):
+                        channel = (
+                            self.metrics.mysticeti_health_leader_timeout_total
+                        )
+                        channel.labels(str(leader)).inc()
             self.force_new_block_flag = True
             self.try_new_block(connected_authorities)
             return True

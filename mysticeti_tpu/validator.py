@@ -36,6 +36,7 @@ from .committee import Committee
 from .config import Parameters, PrivateConfig
 from .core import Core, CoreOptions
 from .crypto import Signer
+from .health import HealthProbe, SLOThresholds
 from .metrics import MetricReporter, Metrics, serve_metrics
 from .net_sync import NetworkSyncer
 from .tracing import current_authority, logger, setup_logging
@@ -140,6 +141,9 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
 
 
 class Validator:
+    # Production health-probe cadence (seconds between samples).
+    HEALTH_INTERVAL_S = 5.0
+
     def __init__(self) -> None:
         self.network_syncer: Optional[NetworkSyncer] = None
         self.metrics: Optional[Metrics] = None
@@ -147,6 +151,37 @@ class Validator:
         self.generator: Optional[TransactionGenerator] = None
         self._metrics_server = None
         self.core: Optional[Core] = None
+        self.health: Optional[HealthProbe] = None
+
+    def _start_health(self, authority, committee, observer, block_verifier):
+        """Wire the fleet health plane: probe + SLO watchdog + (when span
+        tracing is active) commit critical-path attribution."""
+        from . import spans
+
+        probe = HealthProbe(
+            authority,
+            len(committee),
+            metrics=self.metrics,
+            slo=SLOThresholds(
+                max_round_stall_s=float(
+                    os.environ.get("MYSTICETI_SLO_ROUND_STALL_S", "30")
+                ),
+                max_authority_lag_rounds=int(
+                    os.environ.get("MYSTICETI_SLO_AUTHORITY_LAG", "100")
+                ),
+                max_breaker_open_fraction=0.5,
+            ),
+        )
+        probe.attach(
+            core=self.core,
+            net_syncer=self.network_syncer,
+            block_verifier=block_verifier,
+            commit_observer=observer,
+        )
+        tracer = spans.active()
+        if tracer is not None:
+            probe.attach_critical_path(tracer)
+        self.health = probe.start(self.HEALTH_INTERVAL_S)
 
     # -- storage (validator.rs:334-352) --
 
@@ -241,9 +276,12 @@ class Validator:
         await v.network_syncer.start()
         v.generator.start()
         v.reporter = MetricReporter(v.metrics).start()
+        v._start_health(authority, committee, observer, block_verifier)
         if serve_metrics_endpoint and parameters.identifiers:
             host, port = parameters.metrics_address(authority)
-            v._metrics_server = await serve_metrics(v.metrics, "0.0.0.0", port)
+            v._metrics_server = await serve_metrics(
+                v.metrics, "0.0.0.0", port, health_probe=v.health
+            )
         return v
 
     # -- production node (validator.rs:165-212) --
@@ -296,28 +334,39 @@ class Validator:
                 metrics=v.metrics,
                 max_latency_s=parameters.network_connection_max_latency_s,
             )
+        block_verifier = _make_verifier(verifier, committee, v.metrics)
         v.network_syncer = NetworkSyncer(
             core,
             observer,
             network,
             parameters=parameters,
-            block_verifier=_make_verifier(verifier, committee, v.metrics),
+            block_verifier=block_verifier,
             metrics=v.metrics,
             start_wal_sync_thread=True,
         )
         await v.network_syncer.start()
         v.reporter = MetricReporter(v.metrics).start()
+        v._start_health(authority, committee, observer, block_verifier)
         return v, handler, consumer
 
     async def stop(self) -> None:
         if self.generator is not None:
             self.generator.stop()
         if self.reporter is not None:
-            self.reporter.stop()
+            # Final percentile sweep: an orderly shutdown publishes the tail
+            # window instead of losing everything since the last 60 s tick.
+            self.reporter.stop(final=True)
+        if self.health is not None:
+            self.health.stop()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if self.network_syncer is not None:
             await self.network_syncer.stop()
+        # Span-trace tail: the periodic flusher runs every few seconds, so a
+        # short run stopped between flushes would lose its newest spans.
+        from . import spans
+
+        spans.flush_active()
         if self.core is not None:
             self.core.wal_writer.close()
             # Release the WAL reader too (fd + whole-file mmap): embeddings

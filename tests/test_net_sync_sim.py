@@ -61,7 +61,7 @@ def build_node(committee, signers, authority, tmp_dir, sim_net, parameters):
 
 
 async def _run_nodes(n, tmp_dir, virtual_seconds, fault=None, leaders=1,
-                     committee=None, parameters=None):
+                     committee=None, parameters=None, health_out=None):
     if committee is None:
         committee = Committee.new_test([1] * n)
     signers = Committee.benchmark_signers(n)
@@ -72,12 +72,36 @@ async def _run_nodes(n, tmp_dir, virtual_seconds, fault=None, leaders=1,
         build_node(committee, signers, a, tmp_dir, sim_net, parameters)
         for a in range(n)
     ]
+    monitor = None
+    if health_out is not None:
+        # Fleet health plane riding the sim (health_out: a mutable dict
+        # receiving {"monitor": FleetHealthMonitor}): one probe per node,
+        # centrally sampled on the virtual clock, with the SLO watchdog
+        # armed — the run asserts its own diagnosis.
+        from mysticeti_tpu.health import FleetHealthMonitor, HealthProbe
+
+        slo = health_out.pop("slo")
+        probes = {
+            a: HealthProbe(a, n, slo=slo).attach(
+                core=node.core,
+                net_syncer=node,
+                commit_observer=node.syncer.commit_observer,
+            )
+            for a, node in enumerate(nodes)
+        }
+        monitor = FleetHealthMonitor(probes.get, n, interval_s=1.0)
+        health_out["monitor"] = monitor
     for node in nodes:
         await node.start()
     await sim_net.connect_all()
+    if monitor is not None:
+        monitor.start()
     if fault is not None:
         await fault(sim_net, nodes)
     await asyncio.sleep(virtual_seconds)
+    if monitor is not None:
+        monitor.stop()
+        monitor.tick()  # final sample for the participation verdict
     for node in nodes:
         await node.stop()
     sim_net.close()
@@ -213,14 +237,31 @@ def _hundred_nodes_scenario(tmp_path):
         STAKE_WEIGHTED,
     )
 
+    from mysticeti_tpu.health import SLOThresholds
+
     n = 100
     signers = C.benchmark_signers(n)
     committee = C(
         [Authority(1 + (i % 3), s.public_key) for i, s in enumerate(signers)],
         leader_election=STAKE_WEIGHTED,
     )
+    # Health plane armed (VERDICT weak #7): beyond committing, the run must
+    # assert its own diagnosis — no SLO alert fires and every authority
+    # stays above the participation floor.  Thresholds sized for a healthy
+    # 5-virtual-second run: rounds advance well under 4 s apart, commits
+    # flow from the first waves, and no authority's frontier should trail
+    # by anything close to 10 rounds.
+    health = {
+        "slo": SLOThresholds(
+            max_round_stall_s=4.0,
+            max_commit_stall_s=4.0,
+            max_authority_lag_rounds=10,
+        )
+    }
     nodes = run_simulation(
-        _run_nodes(n, str(tmp_path), 5.0, committee=committee), seed=31
+        _run_nodes(n, str(tmp_path), 5.0, committee=committee,
+                   health_out=health),
+        seed=31,
     )
     sequences = [_committed(node) for node in nodes]
     _assert_prefix_consistent(sequences)
@@ -229,6 +270,13 @@ def _hundred_nodes_scenario(tmp_path):
     assert lengths[-1] - lengths[0] <= 8, (lengths[0], lengths[-1])
     leaders = {ref.authority for seq in sequences for ref in seq}
     assert len(leaders) >= 15, sorted(leaders)
+    # The run's own health report is green: no watchdog alert, full
+    # participation, every frontier within the lag floor.
+    report = health["monitor"].fleet_report()
+    assert report["status"] == "ok", report
+    assert report["alerts"] == [], report["alerts"][:5]
+    assert report["participation"] == 1.0, report
+    assert report["samples"] >= 4, report
 
 
 @pytest.mark.skipif(

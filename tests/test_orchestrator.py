@@ -210,6 +210,20 @@ def test_local_benchmark_end_to_end(tmp_path):
     assert c.benchmark_duration() > 0
     assert c.aggregate_tps() > 0, c.display_summary()
     assert os.path.exists(str(tmp_path / "results" / "measurements-0.json"))
+    # The run ships with its own diagnosis (health plane): the cluster
+    # snapshot timeline rode the scrape loop, persisted, and summarizes.
+    assert c.health_samples, "no fleet health snapshots recorded"
+    snap = c.health_samples[-1]
+    assert set(snap["reachable"]) <= {"0", "1", "2"}
+    assert "quorum_participation" in snap and "straggler_score" in snap
+    from mysticeti_tpu.orchestrator.measurement import MeasurementsCollection
+
+    reloaded = MeasurementsCollection.load(
+        str(tmp_path / "results" / "measurements-0.json")
+    )
+    assert reloaded.health_samples == c.health_samples
+    assert reloaded.health_summary() is not None
+    assert "fleet health" in c.display_summary()
 
 
 def test_benchmark_duration_starts_at_first_commit(tmp_path, monkeypatch):

@@ -377,6 +377,106 @@ def test_span_registry_parsed_from_spans_py():
     assert parsed == STAGES
 
 
+# -- rule 8: metrics-doc ------------------------------------------------------
+
+_METRICS_SRC = """
+import ast
+from prometheus_client import Counter
+
+
+class M:
+    def __init__(self, r):
+        def counter(name, doc, labels=()):
+            return Counter(name, doc, labelnames=labels, registry=r)
+
+        self.committed = counter("committed_leaders_total", "x")
+        self.health = counter(
+            "mysticeti_health_commit_rate", "x", labels=("authority",)
+        )
+"""
+
+
+def _doc_findings(doc_text):
+    import ast as _ast
+    import textwrap as _tw
+
+    from mysticeti_tpu.analysis import check_metrics_doc, collect_metric_names
+
+    names = collect_metric_names(_ast.parse(_tw.dedent(_METRICS_SRC)))
+    return check_metrics_doc(
+        names, "mysticeti_tpu/metrics.py", _tw.dedent(doc_text),
+        "docs/observability.md",
+    )
+
+
+def test_metrics_doc_clean_when_inventory_matches():
+    findings = _doc_findings(
+        """
+        | `committed_leaders_total` | counter | decided leaders |
+        | `mysticeti_health_commit_rate` | gauge | commits/s |
+        The `mysticeti_health_*` family is sampled by the probe.
+        """
+    )
+    assert findings == []  # wildcard families never count as series
+
+
+def test_metrics_doc_flags_registered_but_undocumented():
+    findings = _doc_findings(
+        "| `mysticeti_health_commit_rate` | gauge | commits/s |\n"
+    )
+    assert [f.rule for f in findings] == ["metrics-doc"]
+    assert "committed_leaders_total" in findings[0].message
+    assert findings[0].path == "mysticeti_tpu/metrics.py"
+    assert findings[0].line > 0  # anchored at the registration line
+
+
+def test_metrics_doc_flags_documented_but_unregistered():
+    findings = _doc_findings(
+        """
+        | `committed_leaders_total` | counter | decided leaders |
+        | `mysticeti_health_commit_rate` | gauge | commits/s |
+        | `mysticeti_health_ghost_series` | gauge | renamed away |
+        """
+    )
+    assert [f.rule for f in findings] == ["metrics-doc"]
+    assert "mysticeti_health_ghost_series" in findings[0].message
+    assert findings[0].path == "docs/observability.md"
+
+
+def test_metrics_doc_token_match_is_word_bounded():
+    # `latency_s` must not ride on `latency_squared_s`-style substrings.
+    import ast as _ast
+
+    from mysticeti_tpu.analysis import check_metrics_doc, collect_metric_names
+
+    names = collect_metric_names(
+        _ast.parse("self.latency_s = counter('latency_s', 'x')")
+    )
+    findings = check_metrics_doc(
+        names, "m.py", "only `latency_s_total_squared_x` here", "d.md"
+    )
+    assert len(findings) == 1 and "latency_s" in findings[0].message
+
+
+def test_metrics_doc_repo_gate_inventory_is_complete():
+    """The committed tree's inventory: every registered series documented,
+    every documented mysticeti_* series registered (baseline stays empty,
+    so this is the live drift gate)."""
+    import ast as _ast
+
+    from mysticeti_tpu.analysis import check_metrics_doc, collect_metric_names
+
+    with open(os.path.join(PKG, "metrics.py")) as fh:
+        names = collect_metric_names(_ast.parse(fh.read()))
+    assert len(names) > 40  # the real registry, not a parse miss
+    with open(os.path.join(REPO, "docs", "observability.md")) as fh:
+        doc = fh.read()
+    findings = check_metrics_doc(
+        names, "mysticeti_tpu/metrics.py", doc, "docs/observability.md"
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # -- suppressions and baseline ------------------------------------------------
 
 def test_inline_suppression_matches_rule():

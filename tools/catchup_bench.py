@@ -218,6 +218,15 @@ async def run_one(verifier: str, nodes: int, load: int, down_s: float,
         result["host_after_recovery"] = {
             k: host[k] for k in ("cpu_pct", "load_1m") if k in host
         }
+    # Fleet health snapshot at the finish line (health plane): the artifact
+    # says whether the recovered fleet is actually green — participation,
+    # stragglers, SLO alerts — not just that the victim's round caught up.
+    from mysticeti_tpu.health import cluster_snapshot_from_texts
+
+    texts = {}
+    for authority in range(nodes):
+        texts[str(authority)] = await runner.scrape(authority)
+    result["health_after_recovery"] = cluster_snapshot_from_texts(texts, nodes)
     await runner.cleanup()
     return result
 

@@ -1,0 +1,218 @@
+#!/usr/bin/env python3
+"""Fleet health monitor: scrape every node, diagnose, record, render.
+
+The fleet-level half of the health plane (``mysticeti_tpu/health.py``):
+scrapes every node's ``/metrics`` endpoint on an interval (the same
+prometheus parsing the orchestrator's measurement scraper uses), computes
+cluster health — quorum participation, per-authority straggler scores,
+cross-node commit skew, SLO alert totals — embeds the hostmon weather
+snapshot, flushes a JSON health timeline ATOMICALLY every tick (a killed
+run keeps its last complete snapshot), and renders a live terminal
+dashboard.
+
+Usage:
+    # explicit targets
+    python tools/fleetmon.py --targets 127.0.0.1:1600 127.0.0.1:1601 \
+        --out fleetmon.json --interval 2 --duration 60
+
+    # or point it at an orchestrator/testbed working directory (reads the
+    # metrics addresses from parameters.yaml)
+    python tools/fleetmon.py --fleet-dir benchmark-fleet --out fleetmon.json
+
+``--once`` takes a single snapshot and exits (CI artifact mode);
+``--no-dashboard`` suppresses the terminal rendering for headless runs.
+Exit status is 0 when the final snapshot is healthy, 3 when degraded —
+scriptable as a fleet readiness gate.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mysticeti_tpu.health import (  # noqa: E402
+    SLOThresholds,
+    cluster_snapshot_from_texts,
+)
+from mysticeti_tpu.orchestrator.runner import _http_get_metrics  # noqa: E402
+
+
+def resolve_targets(args) -> List[Tuple[str, int]]:
+    if args.targets:
+        out = []
+        for t in args.targets:
+            host, _, port = t.rpartition(":")
+            out.append((host or "127.0.0.1", int(port)))
+        return out
+    if args.fleet_dir:
+        from mysticeti_tpu.config import Parameters
+
+        parameters = Parameters.load(
+            os.path.join(args.fleet_dir, "parameters.yaml")
+        )
+        return [
+            parameters.metrics_address(a)
+            for a in range(len(parameters.identifiers))
+        ]
+    raise SystemExit("need --targets or --fleet-dir")
+
+
+async def scrape_all(targets) -> Dict[str, Optional[str]]:
+    texts = await asyncio.gather(
+        *(_http_get_metrics(host, port) for host, port in targets)
+    )
+    return {str(i): text for i, text in enumerate(texts)}
+
+
+def weather_sample(sampler) -> Optional[dict]:
+    if sampler is None:
+        return None
+    sample = sampler.sample()
+    return {
+        k: sample[k]
+        for k in ("cpu_pct", "load_1m", "mem_available_mb")
+        if k in sample
+    }
+
+
+def atomic_write(path: str, doc: dict) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def render_dashboard(snapshot: dict, targets, tick: int) -> str:
+    """One frame of the terminal dashboard (ANSI home+clear per tick)."""
+    lines = [
+        f"fleetmon  tick {tick}  status: {snapshot['status'].upper()}"
+        f"  participation {snapshot['quorum_participation']:.2f}"
+        f"  commit skew {snapshot['commit_skew_rounds']}r"
+        f"  max commit round {snapshot['max_commit_round']}",
+    ]
+    weather = snapshot.get("weather")
+    if weather:
+        lines.append(
+            "weather: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(weather.items()))
+        )
+    lines.append(f"{'node':<6}{'state':<12}{'commit/s':>10}{'straggler':>12}")
+    stragglers = snapshot.get("straggler_score", {})
+    rates = snapshot.get("commit_rate_by_node", {})
+    for i in range(len(targets)):
+        node = str(i)
+        if node in snapshot["unreachable"]:
+            state = "UNREACHABLE"
+        elif node in snapshot.get("degraded_nodes", []):
+            state = "degraded"
+        else:
+            state = "ok"
+        lines.append(
+            f"{node:<6}{state:<12}{rates.get(node, 0.0):>10.3f}"
+            f"{stragglers.get(node, 0):>12}"
+        )
+    alerts = snapshot.get("slo_alert_totals", {})
+    if alerts:
+        lines.append(
+            "alerts: "
+            + "  ".join(f"{k}={v:.0f}" for k, v in sorted(alerts.items()))
+        )
+    if snapshot.get("degraded_reasons"):
+        lines.append("degraded: " + "; ".join(snapshot["degraded_reasons"]))
+    return "\n".join(lines)
+
+
+async def run(args) -> int:
+    targets = resolve_targets(args)
+    slo = SLOThresholds(min_participation=args.min_participation)
+    sampler = None
+    try:
+        from mysticeti_tpu.orchestrator.hostmon import HostSampler
+
+        sampler = HostSampler()
+    except ImportError:  # no psutil: timeline rides without weather
+        pass
+    # Bounded history: run-forever mode must not grow memory (or the
+    # per-tick rewrite) without limit — beyond the cap the oldest ticks
+    # roll off and the artifact says how many it dropped.
+    max_ticks = max(1, args.max_ticks)
+    timeline: List[dict] = []
+    dropped_ticks = 0
+    started = time.time()
+    tick = 0
+    last_snapshot: Optional[dict] = None
+    while True:
+        tick += 1
+        texts = await scrape_all(targets)
+        snapshot = cluster_snapshot_from_texts(texts, len(targets), slo=slo)
+        snapshot["t"] = round(time.time() - started, 3)
+        weather = weather_sample(sampler)
+        if weather is not None:
+            snapshot["weather"] = weather
+        timeline.append(snapshot)
+        if len(timeline) > max_ticks:
+            timeline.pop(0)
+            dropped_ticks += 1
+        last_snapshot = snapshot
+        if args.out:
+            atomic_write(
+                args.out,
+                {
+                    "targets": [f"{h}:{p}" for h, p in targets],
+                    "interval_s": args.interval,
+                    "window_utc": [round(started, 1), round(time.time(), 1)],
+                    "slo": slo.to_dict(),
+                    "dropped_ticks": dropped_ticks,
+                    "timeline": timeline,
+                },
+            )
+        if not args.no_dashboard:
+            frame = render_dashboard(snapshot, targets, tick)
+            sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+            sys.stdout.flush()
+        if args.once or (
+            args.duration and time.time() - started >= args.duration
+        ):
+            break
+        await asyncio.sleep(args.interval)
+    if args.no_dashboard and last_snapshot is not None:
+        print(render_dashboard(last_snapshot, targets, tick))
+    return 0 if last_snapshot and last_snapshot["status"] == "ok" else 3
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleetmon", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--targets", nargs="*", default=None,
+                        help="metrics endpoints as host:port")
+    parser.add_argument("--fleet-dir", default=None,
+                        help="orchestrator working dir (reads parameters.yaml)")
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--duration", type=float, default=0.0,
+                        help="stop after this many seconds (0 = forever)")
+    parser.add_argument("--once", action="store_true",
+                        help="one snapshot, then exit")
+    parser.add_argument("--out", default=None,
+                        help="JSON health-timeline path (atomically rewritten "
+                        "every tick)")
+    parser.add_argument("--min-participation", type=float, default=0.67)
+    parser.add_argument("--max-ticks", type=int, default=2880,
+                        help="keep at most this many timeline ticks in "
+                        "memory/on disk (oldest roll off; default = 4h at "
+                        "the 5s interval)")
+    parser.add_argument("--no-dashboard", action="store_true")
+    args = parser.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,12 @@ def _probe_dicts(collections) -> list:
         host = c.host_summary()
         if host is not None:
             probe["host"] = host
+        health = c.health_summary()
+        if health is not None:
+            # The probe ships with its own diagnosis (health plane): a peak
+            # taken while participation dipped or SLO alerts fired is
+            # visible in the artifact itself, not just in hindsight.
+            probe["health"] = health
         probes.append(probe)
     return probes
 

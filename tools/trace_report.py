@@ -2,32 +2,88 @@
 """Per-stage latency breakdown from a MYSTICETI_TRACE Chrome trace file.
 
 Usage:
-    python tools/trace_report.py trace.json [--by-track]
+    python tools/trace_report.py trace.json [--by-track] [--critical-path]
 
 Reads the trace-event JSON written by ``mysticeti_tpu.spans`` (set
 ``MYSTICETI_TRACE=/path/out.json`` on a node or testbed run, or load the
 same file in Perfetto for the visual timeline) and prints count / p50 / p90 /
 p99 / max duration per pipeline stage — the "which stage ate the commit
 latency" table.  ``--by-track`` splits the breakdown per authority track.
+
+``--critical-path`` prints commit critical-path attribution instead: per
+committed leader (a block with a ``commit`` span), which pipeline stage
+dominated its receive -> verify -> dag_add -> proposal_wait -> commit ->
+finalize chain, attributed to the leader's authoring authority — the top
+blocking (stage, authority) pairs are the fleet's slow edges.
+
+A truncated trace (node SIGKILLed mid-flush, or a live ``.tmp``) is
+salvaged: complete events before the tear are recovered with a note
+instead of a traceback, and empty stages / span-free traces report
+themselves and exit 0.
 """
 import argparse
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 from typing import Dict, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from mysticeti_tpu.spans import STAGES  # noqa: E402
+from mysticeti_tpu.spans import PIPELINE_STAGES, STAGES  # noqa: E402
 
 
-def load_events(path: str) -> List[dict]:
+def _salvage_events(text: str) -> List[dict]:
+    """Recover complete event objects from a truncated trace: find the
+    traceEvents array and raw-decode objects one at a time until the tear."""
+    start = text.find('"traceEvents"')
+    if start < 0:
+        return []
+    start = text.find("[", start)
+    if start < 0:
+        return []
+    decoder = json.JSONDecoder()
+    events: List[dict] = []
+    pos = start + 1
+    n = len(text)
+    while pos < n:
+        while pos < n and text[pos] in " \t\r\n,":
+            pos += 1
+        if pos >= n or text[pos] == "]":
+            break
+        try:
+            event, pos = decoder.raw_decode(text, pos)
+        except ValueError:
+            break  # the tear: everything before it is intact
+        if isinstance(event, dict):
+            events.append(event)
+    return events
+
+
+def load_events(path: str) -> Tuple[List[dict], str]:
     """All events from a Chrome trace-event JSON file (parsed once — a
-    MAX_EVENTS-capped production trace is hundreds of MB)."""
+    MAX_EVENTS-capped production trace is hundreds of MB).  Returns
+    ``(events, note)``: a truncated/mid-flush tail is tolerated by salvaging
+    the complete events before the tear, reported through ``note``."""
     with open(path) as f:
-        data = json.load(f)
-    return data["traceEvents"] if isinstance(data, dict) else data
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except ValueError:
+        events = _salvage_events(text)
+        return events, (
+            f"note: trace is truncated (mid-flush tail?); salvaged "
+            f"{len(events)} complete event(s)"
+        )
+    if isinstance(data, dict):
+        events = data.get("traceEvents")
+        if not isinstance(events, list):
+            return [], "note: no traceEvents array in trace"
+        return events, ""
+    if isinstance(data, list):
+        return data, ""
+    return [], "note: unrecognized trace shape"
 
 
 def load_spans(events: List[dict]) -> List[dict]:
@@ -44,6 +100,8 @@ def _track_names(events: List[dict]) -> Dict[Tuple[int, int], str]:
 
 
 def _pct(ordered: List[float], pct: float) -> float:
+    if not ordered:
+        return 0.0
     idx = min(len(ordered) - 1, int(len(ordered) * pct / 100))
     return ordered[idx]
 
@@ -80,9 +138,98 @@ def build_report(spans: List[dict], by_track: bool = False,
             f"{_pct(durs, 50):>10.3f}"
             f"{_pct(durs, 90):>10.3f}"
             f"{_pct(durs, 99):>10.3f}"
-            f"{durs[-1]:>10.3f}"
+            f"{(durs[-1] if durs else 0.0):>10.3f}"
         )
         lines.append(row)
+    return "\n".join(lines)
+
+
+# -- commit critical-path attribution ----------------------------------------
+
+_REF_RE = re.compile(r"^A(\d+)R(\d+)#")
+
+
+def attribute_critical_paths(spans: List[dict]) -> List[dict]:
+    """Offline twin of ``health.CriticalPathAnalyzer``: per committed leader
+    (block label with a ``commit`` span) and observing track, the pipeline
+    stage with the largest duration is THE critical-path edge, attributed to
+    the leader's authoring authority.  Returns one record per (leader,
+    track)."""
+    # (track, label) -> {stage: dur_s}; only pipeline stages participate.
+    chains: Dict[Tuple[Tuple[int, int], str], Dict[str, float]] = defaultdict(dict)
+    for e in spans:
+        if e["name"] not in PIPELINE_STAGES:
+            continue
+        label = (e.get("args") or {}).get("block")
+        if not label:
+            continue
+        track = (e.get("pid", 0), e.get("tid", 0))
+        dur = e.get("dur", 0) / 1e6
+        prev = chains[(track, label)].get(e["name"])
+        chains[(track, label)][e["name"]] = max(prev or 0.0, dur)
+    out: List[dict] = []
+    for (track, label), stages in chains.items():
+        if "commit" not in stages:
+            continue  # never committed (or commit fell past the trace cap)
+        match = _REF_RE.match(label)
+        blocking = max(stages, key=lambda s: (stages[s], s))
+        out.append(
+            {
+                "leader": label,
+                "authority": int(match.group(1)) if match else None,
+                "round": int(match.group(2)) if match else None,
+                "track": track,
+                "blocking_stage": blocking,
+                "blocking_s": stages[blocking],
+                "stages": stages,
+            }
+        )
+    return out
+
+
+def build_critical_path_report(spans: List[dict]) -> str:
+    attributed = attribute_critical_paths(spans)
+    if not attributed:
+        return (
+            "no committed leaders in trace (nothing reached a `commit` "
+            "span); critical-path attribution needs a run that commits"
+        )
+    # Top blocking (stage, authority) pairs by total blocked seconds.
+    pairs: Dict[Tuple[str, int], List[float]] = defaultdict(list)
+    per_stage: Dict[str, List[float]] = defaultdict(list)
+    for rec in attributed:
+        if rec["authority"] is not None:
+            pairs[(rec["blocking_stage"], rec["authority"])].append(
+                rec["blocking_s"]
+            )
+        for stage, dur in rec["stages"].items():
+            per_stage[stage].append(dur * 1e3)
+    lines = [
+        f"critical-path attribution over {len(attributed)} committed "
+        "leader observation(s)",
+        "",
+        f"{'stage':<16}{'authority':>10}{'leaders':>9}{'blocked_s':>11}"
+        f"{'mean_ms':>10}",
+    ]
+    ranked = sorted(
+        pairs.items(), key=lambda kv: (-sum(kv[1]), kv[0])
+    )
+    for (stage, authority), durs in ranked[:10]:
+        lines.append(
+            f"{stage:<16}{authority:>10}{len(durs):>9}"
+            f"{sum(durs):>11.3f}{sum(durs) / len(durs) * 1e3:>10.3f}"
+        )
+    lines.append("")
+    lines.append(
+        f"{'stage (on path)':<16}{'count':>8}{'p50_ms':>10}{'p90_ms':>10}"
+        f"{'max_ms':>10}"
+    )
+    for stage in sorted(per_stage, key=lambda s: _stage_order(s)):
+        durs = sorted(per_stage[stage])
+        lines.append(
+            f"{stage:<16}{len(durs):>8}{_pct(durs, 50):>10.3f}"
+            f"{_pct(durs, 90):>10.3f}{durs[-1]:>10.3f}"
+        )
     return "\n".join(lines)
 
 
@@ -96,15 +243,25 @@ def main(argv=None) -> int:
         "--by-track", action="store_true",
         help="split the breakdown per authority track",
     )
+    parser.add_argument(
+        "--critical-path", action="store_true",
+        help="commit critical-path attribution: top blocking "
+        "(stage, authority) pairs per committed leader",
+    )
     args = parser.parse_args(argv)
     try:
-        events = load_events(args.trace)
-    except (OSError, ValueError, KeyError) as exc:
+        events, note = load_events(args.trace)
+    except OSError as exc:
         print(f"error: cannot read trace {args.trace}: {exc}", file=sys.stderr)
         return 2
+    if note:
+        print(note, file=sys.stderr)
+    spans = load_spans(events)
+    if args.critical_path:
+        print(build_critical_path_report(spans))
+        return 0
     names = _track_names(events) if args.by_track else {}
-    print(build_report(load_spans(events), by_track=args.by_track,
-                       track_names=names))
+    print(build_report(spans, by_track=args.by_track, track_names=names))
     return 0
 
 
