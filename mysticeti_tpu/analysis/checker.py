@@ -95,6 +95,12 @@ GUARDED_FIELDS: Dict[str, str] = {
     # out/in from any executor thread; the live-connection count must move
     # with the deque under one lock or the bound drifts.
     "_pool_size": "_pool_lock",
+    # Segmented WAL manifest table (storage.py): the segment list is
+    # rewritten by the appender on roll/GC/tear-truncation and read by the
+    # paired reader, the metrics sampler, and the fsync thread — every
+    # reassignment must happen under the table lock or a reader resolves a
+    # position against a half-swapped table.
+    "_segments": "_seg_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

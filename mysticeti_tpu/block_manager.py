@@ -27,6 +27,32 @@ class BlockManager:
         self.missing: List[Set[BlockReference]] = [set() for _ in range(num_authorities)]
         self.block_store = block_store
         self._metrics = metrics
+        # Storage-GC floor (storage.py): includes strictly below it are
+        # treated as satisfied — the blocks were retired from disk here
+        # (and from well-behaved peers), so parking/fetching on them would
+        # wait forever.  Raised by Core.cleanup and by snapshot adoption.
+        self.gc_floor = 0
+
+    def set_gc_floor(
+        self, gc_floor: int, block_writer: BlockWriter
+    ) -> Tuple[List[Tuple[WalPosition, StatementBlock]], Set[BlockReference]]:
+        """Raise the floor, forget sub-floor missing refs, and re-evaluate
+        every parked block against the new rule (a snapshot-streamed block
+        whose parents sit below the adopted floor releases here).  Returns
+        the same shape as :meth:`add_blocks` so the caller can ingest the
+        released blocks through its normal path."""
+        if gc_floor <= self.gc_floor:
+            return [], set()
+        self.gc_floor = gc_floor
+        for refs in self.missing:
+            stale = {r for r in refs if r.round < gc_floor}
+            refs -= stale
+        parked = list(self.blocks_pending.values())
+        self.blocks_pending.clear()
+        self.block_references_waiting.clear()
+        if not parked:
+            return [], set()
+        return self.add_blocks(parked, block_writer)
 
     def add_blocks(
         self, blocks: Sequence[StatementBlock], block_writer: BlockWriter
@@ -39,11 +65,20 @@ class BlockManager:
         while queue:
             block = queue.popleft()
             reference = block.reference
+            if reference.round < self.gc_floor:
+                # Settled history: consensus has permanently moved past this
+                # round and the store retired it.  Re-ingesting (a straggler
+                # re-delivering an ancient block, a far-behind peer's stale
+                # proposal) would re-vote and re-include blocks every healthy
+                # aggregator already certified-and-retired — drop it.
+                continue
             if self.block_store.block_exists(reference) or reference in self.blocks_pending:
                 continue
 
             processed = True
             for include in block.includes:
+                if include.round < self.gc_floor:
+                    continue  # settled below the GC floor: never park on it
                 if self.block_store.block_exists(include):
                     continue
                 processed = False
@@ -86,4 +121,8 @@ class BlockManager:
         return self.missing
 
     def exists_or_pending(self, reference: BlockReference) -> bool:
+        # Sub-floor references read as settled so the dedup gate drops their
+        # re-deliveries BEFORE paying signature verification.
+        if reference.round < self.gc_floor:
+            return True
         return self.block_store.block_exists(reference) or reference in self.blocks_pending

@@ -46,7 +46,6 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from .block_handler import TestBlockHandler
-from .block_store import BlockStore
 from .commit_observer import TestCommitObserver
 from .committee import Committee
 from .config import Parameters
@@ -58,7 +57,6 @@ from .simulated_network import SimulatedNetwork
 from .tracing import logger
 from .types import BlockReference
 from .utils.tasks import spawn_logged
-from .wal import walf
 
 log = logger(__name__)
 
@@ -290,10 +288,35 @@ class SafetyChecker:
 
     def __init__(self) -> None:
         self._anchors: Dict[int, Dict[int, BlockReference]] = {}
+        # Snapshot catch-up: per-authority adopted baseline height.  Heights
+        # inside the adopted prefix were committed by the FLEET while the
+        # node was away — a gap wholly below the baseline is the expected
+        # catch-up shape, not a linearizer-order violation.  The adopted
+        # anchor itself is recorded, so cross-node consistency still covers
+        # the baseline height.
+        self._adopted: Dict[int, int] = {}
         # First mid-run violation, re-raised by check(): an observe() raise
         # inside a node's accept pipeline is logged there, not propagated,
         # so the end-of-run audit must still fail the scenario.
         self._violation: Optional[SafetyViolation] = None
+
+    def note_adopted(
+        self, authority: int, height: int, leader: Optional[BlockReference]
+    ) -> None:
+        """The authority adopted a snapshot baseline at ``height``."""
+        self._adopted[authority] = max(self._adopted.get(authority, 0), height)
+        if leader is not None and height > 0:
+            mine = self._anchors.setdefault(authority, {})
+            prev = mine.get(height)
+            if prev is not None and prev != leader:
+                violation = SafetyViolation(
+                    f"authority {authority} adopted anchor {leader!r} at "
+                    f"height {height} but had committed {prev!r}"
+                )
+                if self._violation is None:
+                    self._violation = violation
+                raise violation
+            mine[height] = leader
 
     def observe(self, authority: int, committed) -> None:
         """Record a node's freshly committed sub-dags (List[CommittedSubDag])."""
@@ -316,16 +339,21 @@ class SafetyChecker:
 
     def sequence(self, authority: int) -> List[BlockReference]:
         """The node's committed anchors in height order; raises on gaps
-        (a hole means commits were observed out of linearizer order)."""
+        (a hole means commits were observed out of linearizer order).  A
+        gap lying wholly below the authority's adopted snapshot baseline is
+        the legal catch-up shape (see :meth:`note_adopted`)."""
         mine = self._anchors.get(authority, {})
+        adopted = self._adopted.get(authority, 0)
         out: List[BlockReference] = []
-        for expect, height in enumerate(sorted(mine), start=1):
-            if height != expect:
+        expect = 1
+        for height in sorted(mine):
+            if height != expect and height - 1 > adopted:
                 raise SafetyViolation(
                     f"authority {authority} has a commit gap at height "
                     f"{expect} (next observed: {height})"
                 )
             out.append(mine[height])
+            expect = height + 1
         return out
 
     def check(self) -> None:
@@ -359,6 +387,14 @@ class _CheckedCommitObserver(TestCommitObserver):
         committed = super().handle_commit(committed_leaders)
         self._checker.observe(self._checked_authority, committed)
         return committed
+
+    def adopt_snapshot(self, manifest):
+        super().adopt_snapshot(manifest)
+        self._checker.note_adopted(
+            self._checked_authority,
+            manifest.commit_height,
+            manifest.last_committed_leader,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -436,10 +472,11 @@ class ChaosSimHarness:
         return os.path.join(self.wal_dir, f"wal-{authority}")
 
     def _build_node(self, authority: int) -> NetworkSyncer:
-        wal_writer, wal_reader = walf(self._wal_path(authority))
-        recovered, observer_recovered = BlockStore.open(
-            authority, wal_reader, wal_writer, self.committee,
-            self.metrics[authority],
+        from .storage import open_store
+
+        recovered, observer_recovered, wal_writer, lifecycle = open_store(
+            authority, self._wal_path(authority), self.committee,
+            self.parameters, self.metrics[authority],
         )
         handler = TestBlockHandler(
             last_transaction=authority * 1_000_000,
@@ -456,6 +493,7 @@ class ChaosSimHarness:
             options=CoreOptions.test(),
             signer=self.signers[authority],
             metrics=self.metrics[authority],
+            storage=lifecycle,
         )
         observer = _CheckedCommitObserver(
             self.checker,
@@ -515,9 +553,13 @@ class ChaosSimHarness:
         node.core.block_store.close()
         self.nodes[authority] = None
         if torn_tail_bytes > 0:
-            path = self._wal_path(authority)
-            size = os.path.getsize(path)
-            with open(path, "r+b") as f:
+            # The tear lands where appends land: the active segment of a
+            # segmented WAL, the file itself for a single-file log.
+            from .storage import active_wal_file
+
+            target = active_wal_file(self._wal_path(authority))
+            size = os.path.getsize(target)
+            with open(target, "r+b") as f:
                 f.truncate(max(0, size - torn_tail_bytes))
 
     async def restart(self, authority: int) -> NetworkSyncer:

@@ -62,6 +62,20 @@ def benchmark_genesis(
             f.write(i.to_bytes(32, "little"))
 
 
+def _apply_storage_overrides(parameters: Parameters, args) -> None:
+    """CLI storage-lifecycle flags override the parameters file (run) or the
+    generated genesis (testbed): one knob block, one override path."""
+    storage = parameters.storage
+    if getattr(args, "gc_depth", None) is not None:
+        storage.gc_depth = args.gc_depth
+    if getattr(args, "segment_bytes", None) is not None:
+        storage.segment_bytes = args.segment_bytes
+    if getattr(args, "checkpoint_interval", None) is not None:
+        storage.checkpoint_interval = args.checkpoint_interval
+    if getattr(args, "snapshot_catchup", False):
+        storage.snapshot_catchup = True
+
+
 async def run_node(
     authority: int,
     committee_path: str,
@@ -69,6 +83,7 @@ async def run_node(
     private_dir: str,
     verifier: str = "cpu",
     tps: Optional[int] = None,
+    storage_args=None,
 ) -> None:
     """main.rs:159-185."""
     from . import spans
@@ -94,6 +109,8 @@ async def run_node(
     exit_after = float(os.environ.get("MYSTICETI_EXIT_AFTER", "0") or 0)
     committee = Committee.load(committee_path)
     parameters = Parameters.load(parameters_path)
+    if storage_args is not None:
+        _apply_storage_overrides(parameters, storage_args)
     private = PrivateConfig.new_in_dir(authority, private_dir)
     seed_path = os.path.join(private_dir, "seed")
     with open(seed_path, "rb") as f:
@@ -148,7 +165,7 @@ async def run_node(
 
 
 async def testbed(committee_size: int, working_dir: str, duration_s: float,
-                  verifier: str = "cpu") -> List:
+                  verifier: str = "cpu", storage_args=None) -> List:
     """N in-process validators on localhost (main.rs:187-227)."""
     from . import spans
 
@@ -158,6 +175,8 @@ async def testbed(committee_size: int, working_dir: str, duration_s: float,
         benchmark_genesis(ips, working_dir)
         committee = Committee.load(os.path.join(working_dir, "committee.yaml"))
         parameters = Parameters.load(os.path.join(working_dir, "parameters.yaml"))
+        if storage_args is not None:
+            _apply_storage_overrides(parameters, storage_args)
         signers = Committee.benchmark_signers(committee_size)
         validators = []
         for i in range(committee_size):
@@ -195,24 +214,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     g.add_argument("--ips", nargs="+", required=True)
     g.add_argument("--working-directory", default="genesis")
 
+    def add_storage_flags(p):
+        p.add_argument("--gc-depth", type=int, default=None,
+                       help="rounds retained behind the last committed "
+                       "leader before WAL segments are deleted (0 = never)")
+        p.add_argument("--segment-bytes", type=int, default=None,
+                       help="WAL segment roll threshold (<= 0 = legacy "
+                       "single-file log: no checkpoints, no GC)")
+        p.add_argument("--checkpoint-interval", type=int, default=None,
+                       help="commits between durable checkpoints (0 = off)")
+        p.add_argument("--snapshot-catchup", action="store_true",
+                       help="arm the snapshot catch-up streams (wire tags "
+                       "9/10/11): far-behind peers bootstrap from a commit "
+                       "baseline + recent block window, not full history")
+
     r = sub.add_parser("run", help="run one validator")
     r.add_argument("--authority", type=int, required=True)
     r.add_argument("--committee-path", required=True)
     r.add_argument("--parameters-path", required=True)
     r.add_argument("--private-config-path", required=True)
     r.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
+    add_storage_flags(r)
 
     d = sub.add_parser("dry-run", help="one validator of an N-node local setup")
     d.add_argument("--committee-size", type=int, required=True)
     d.add_argument("--authority", type=int, required=True)
     d.add_argument("--working-directory", default="dryrun")
     d.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
+    add_storage_flags(d)
 
     t = sub.add_parser("testbed", help="N in-process validators")
     t.add_argument("--committee-size", type=int, required=True)
     t.add_argument("--working-directory", default="testbed")
     t.add_argument("--duration", type=float, default=30.0)
     t.add_argument("--verifier", choices=VERIFIER_CHOICES, default="cpu")
+    add_storage_flags(t)
 
     o = sub.add_parser(
         "orchestrator",
@@ -308,6 +344,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.parameters_path,
                 args.private_config_path,
                 verifier=args.verifier,
+                storage_args=args,
             )
         )
         return 0
@@ -322,13 +359,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 os.path.join(wd, "parameters.yaml"),
                 os.path.join(wd, f"validator-{args.authority}"),
                 verifier=args.verifier,
+                storage_args=args,
             )
         )
         return 0
     if args.command == "testbed":
         committed = asyncio.run(
             testbed(args.committee_size, args.working_directory, args.duration,
-                    args.verifier)
+                    args.verifier, storage_args=args)
         )
         for i, seq in enumerate(committed):
             print(f"validator {i}: {len(seq)} committed leaders")

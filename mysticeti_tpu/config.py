@@ -57,6 +57,42 @@ class SynchronizerParameters:
 
 
 @dataclass
+class StorageParameters:
+    """The storage lifecycle plane's knobs, unified (storage.py).
+
+    Retention used to be scattered: ``Parameters.enable_cleanup`` switched
+    the periodic cleanup task, ``Parameters.store_retain_rounds`` sized the
+    in-memory cache window, and nothing at all bounded the disk.  This block
+    owns all of it:
+
+    * ``segment_bytes`` — the WAL rolls to a new ``wal.NNNNNN`` segment when
+      the active one would exceed this size (``<= 0`` = legacy single-file
+      log: no rolling, no checkpoints, no GC).
+    * ``checkpoint_interval`` — commits between durable checkpoints; ``0``
+      disables checkpointing (recovery then replays the whole log).
+    * ``gc_depth`` — rounds retained behind the last committed leader;
+      segments whose every block is older are deleted.  ``0`` = never GC.
+    * ``retain_rounds`` — the in-memory cache-unload window (the old
+      ``store_retain_rounds``); independent of the on-disk ``gc_depth``.
+    * ``snapshot_catchup`` — arm the snapshot catch-up streams (wire tags
+      9/10/11, docs/wire-format.md §5): a far-behind peer bootstraps from a
+      serving node's commit baseline + post-GC block window instead of
+      pulling all history block-by-block.  Off by default: it is a soft
+      wire extension pre-knob receivers reset on.
+    * ``catchup_threshold_commits`` — minimum commit-height gap before a
+      snapshot is requested/served (below it, the ordinary streams win).
+    """
+
+    segment_bytes: int = 64 * 1024 * 1024
+    checkpoint_interval: int = 512
+    gc_depth: int = 10_000
+    retain_rounds: int = 500
+    enable_cleanup: bool = True
+    snapshot_catchup: bool = False
+    catchup_threshold_commits: int = 200
+
+
+@dataclass
 class Parameters:
     identifiers: List[Identifier] = field(default_factory=list)
     wave_length: int = 3
@@ -65,10 +101,23 @@ class Parameters:
     shutdown_grace_period_s: float = 2.0
     number_of_leaders: int = 1
     enable_pipelining: bool = True
-    enable_cleanup: bool = True
-    store_retain_rounds: int = 500
+    # Legacy spellings of the storage block's knobs: accepted at construction
+    # and in YAML for back-compat, migrated into ``storage`` by __post_init__
+    # (which then rebinds these names to the storage block's values, so every
+    # existing reader keeps working).
+    enable_cleanup: Optional[bool] = None
+    store_retain_rounds: Optional[int] = None
+    storage: StorageParameters = field(default_factory=StorageParameters)
     synchronizer: SynchronizerParameters = field(default_factory=SynchronizerParameters)
     network_connection_max_latency_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.enable_cleanup is not None:
+            self.storage.enable_cleanup = bool(self.enable_cleanup)
+        if self.store_retain_rounds is not None:
+            self.storage.retain_rounds = int(self.store_retain_rounds)
+        self.enable_cleanup = self.storage.enable_cleanup
+        self.store_retain_rounds = self.storage.retain_rounds
 
     @classmethod
     def new_for_benchmarks(cls, ips: List[str]) -> "Parameters":
@@ -111,16 +160,24 @@ class Parameters:
     # -- YAML round-trip (config.rs:16-29) --
 
     def dump(self, path: str) -> None:
+        raw = asdict(self)
+        # The storage block is the canonical spelling; the migrated legacy
+        # keys would otherwise shadow a hand-edited storage block on reload.
+        raw.pop("enable_cleanup", None)
+        raw.pop("store_retain_rounds", None)
         with open(path, "w") as f:
-            yaml.safe_dump(asdict(self), f, sort_keys=False)
+            yaml.safe_dump(raw, f, sort_keys=False)
 
     @classmethod
     def load(cls, path: str) -> "Parameters":
         with open(path) as f:
             raw = yaml.safe_load(f)
         sync = SynchronizerParameters(**raw.pop("synchronizer", {}))
+        storage = StorageParameters(**raw.pop("storage", {}))
         identifiers = [Identifier(**i) for i in raw.pop("identifiers", [])]
-        return cls(identifiers=identifiers, synchronizer=sync, **raw)
+        return cls(
+            identifiers=identifiers, synchronizer=sync, storage=storage, **raw
+        )
 
 
 @dataclass

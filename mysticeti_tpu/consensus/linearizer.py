@@ -58,14 +58,41 @@ class Linearizer:
         self.block_store = block_store
         self.committed: Set[BlockReference] = set()
         self.last_height = 0
+        # Storage-GC floor (storage.py): references strictly below it are
+        # settled — retired from disk, guaranteed inside some committed
+        # history — so the DFS treats them like already-committed blocks.
+        # Also the snapshot catch-up seam: a node that adopted a remote
+        # commit baseline lacks all history below the served floor.
+        self.gc_round = 0
 
     def recover_state(self, recovered: CommitObserverRecoveredState) -> None:
         assert not self.committed and self.last_height == 0
+        self.last_height = recovered.base_height
+        self.committed.update(recovered.base_committed)
+        self.gc_round = max(self.gc_round, recovered.gc_round)
         for commit in recovered.sub_dags:
             assert commit.height > self.last_height
             self.last_height = commit.height
             self.committed.update(commit.sub_dag)
             assert commit.leader in self.committed
+
+    def set_gc_round(self, gc_round: int) -> None:
+        """Raise the floor and prune the committed set below it (the set
+        otherwise grows with the whole run — the GC'd node's memory bound)."""
+        if gc_round <= self.gc_round:
+            return
+        self.gc_round = gc_round
+        self.committed = {r for r in self.committed if r.round >= gc_round}
+
+    def adopt_snapshot(
+        self, height: int, committed_refs, gc_round: int
+    ) -> None:
+        """Snapshot catch-up: jump the sequencer to the remote baseline —
+        heights at or below ``height`` are the adopted prefix, the committed
+        set becomes the baseline's (everything below its floor is settled)."""
+        self.last_height = max(self.last_height, height)
+        self.committed.update(committed_refs)
+        self.set_gc_round(gc_round)
 
     def collect_sub_dag(self, leader_block: StatementBlock) -> CommittedSubDag:
         to_commit: List[StatementBlock] = []
@@ -78,7 +105,7 @@ class Linearizer:
             block = buffer.pop()
             to_commit.append(block)
             for reference in block.includes:
-                if reference in self.committed:
+                if reference in self.committed or reference.round < self.gc_round:
                     continue
                 inner = self.block_store.get_block(reference)
                 assert inner is not None, "whole sub-dag must be stored by now"

@@ -87,8 +87,14 @@ class Core:
         options: Optional[CoreOptions] = None,
         signer: Optional[Signer] = None,
         metrics=None,
+        storage=None,
     ) -> None:
-        """Equivalent of ``Core::open`` (core.rs:69-161)."""
+        """Equivalent of ``Core::open`` (core.rs:69-161).
+
+        ``storage`` is the node's :class:`~mysticeti_tpu.storage.
+        StorageLifecycle` (checkpoint cadence, GC floor, snapshot baseline);
+        ``None`` (bare test cores) keeps the seed behavior: cache eviction
+        only, no checkpoints, unbounded log."""
         block_store: BlockStore = recovered.block_store
         pending = recovered.pending
         threshold_clock = ThresholdClockAggregator(0, metrics)
@@ -122,6 +128,9 @@ class Core:
             )
 
         self.block_manager = BlockManager(block_store, len(committee), metrics)
+        # A checkpoint/snapshot-recovered store lacks everything below its
+        # baseline floor; the manager must never park on those references.
+        self.block_manager.gc_floor = recovered.gc_round
         self.pending: Deque[Tuple[WalPosition, MetaStatement]] = pending
         self.last_own_block: OwnBlockData = last_own_block
         self.block_handler = block_handler
@@ -140,6 +149,7 @@ class Core:
         self.epoch_manager = EpochManager()
         self.rounds_in_epoch = parameters.rounds_in_epoch
         self.store_retain_rounds = parameters.store_retain_rounds
+        self.storage = storage
         self.committer: UniversalCommitter = (
             UniversalCommitterBuilder(committee, block_store, metrics)
             .with_wave_length(parameters.wave_length)
@@ -349,6 +359,10 @@ class Core:
             )
         self.write_state()
         self.write_commits(commit_data, state)
+        if self.storage is not None and commit_data:
+            self.storage.note_commits(commit_data)
+            if self.storage.should_checkpoint():
+                self.storage.write_checkpoint(self, state)
         return commit_data
 
     def write_state(self) -> None:
@@ -362,13 +376,78 @@ class Core:
         w.bytes(state)
         self.wal_writer.write(WAL_ENTRY_COMMIT, w.finish())
 
+    # -- snapshot catch-up (storage.py; driven by the syncer) --
+
+    def apply_snapshot(self, manifest) -> bool:
+        """Adopt a remote commit baseline: persist the manifest (crash-safe
+        re-adoption on replay), jump the decided-leader cursor, raise the
+        block manager's floor, and release any parked blocks the new floor
+        satisfies.  Returns False when the manifest is stale/duplicate."""
+        if self.storage is None or not self.storage.wants_snapshot(manifest):
+            return False
+        from .block_store import WAL_ENTRY_SNAPSHOT
+
+        self.wal_writer.write(WAL_ENTRY_SNAPSHOT, manifest.to_bytes())
+        self.storage.adopt(manifest)
+        leader = manifest.last_committed_leader
+        if leader is not None and (
+            leader.round > self.last_decided_leader.round
+        ):
+            self.last_decided_leader = AuthorityRound(
+                leader.authority, leader.round
+            )
+        log.info(
+            "adopted snapshot baseline: commit height %d, floor round %d",
+            manifest.commit_height, manifest.gc_round,
+        )
+        # Transactions first shared below the floor are history we will
+        # never process; the handler's oracles must expect their votes.
+        self.block_handler.note_catchup(self.storage.retired_round)
+        self._raise_dag_floor(self.storage.retired_round)
+        return True
+
+    def _raise_dag_floor(self, floor: RoundNumber) -> None:
+        """Blocks parked on sub-floor parents release here; they enter the
+        pipeline exactly as ``add_blocks`` would have entered them."""
+        writer = BlockWriter(self.wal_writer, self.block_store)
+        released, _missing = self.block_manager.set_gc_floor(floor, writer)
+        if not released:
+            return
+        result = []
+        for position, block in sorted(released, key=lambda pb: pb[1].round()):
+            self.threshold_clock.add_block(block.reference, self.committee)
+            self.pending.append((position, Include(block.reference)))
+            result.append(block)
+        self.run_block_handler(result)
+
     # -- maintenance --
 
     def cleanup(self) -> None:
         self.block_store.cleanup(
             max(0, self.last_decided_leader.round - self.store_retain_rounds)
         )
+        if self.storage is not None:
+            before = self.storage.retired_round
+            self.storage.collect(self.block_store)
+            if self.storage.retired_round > before:
+                self._raise_dag_floor(self.storage.retired_round)
         self.block_handler.cleanup()
+
+    def dag_floor(self) -> RoundNumber:
+        """The round below which this store holds nothing (GC/adoption)."""
+        return self.storage.retired_round if self.storage is not None else 0
+
+    def commit_height(self) -> int:
+        return self.storage.commit_height if self.storage is not None else 0
+
+    def snapshot_manifest_for(self, peer_height: int):
+        """Server side of snapshot catch-up: a manifest when the peer is far
+        enough behind (and the knob is on), else None."""
+        if self.storage is None or not self.storage.serves_snapshot_for(
+            peer_height
+        ):
+            return None
+        return self.storage.build_manifest()
 
     def wal_syncer(self) -> WalSyncer:
         return self.wal_writer.syncer()

@@ -167,8 +167,15 @@ class CoreTaskDispatcher:
         )
 
     async def cleanup(self) -> None:
-        # internal: driven by the syncer's periodic task.
-        return await self._call(self.syncer.core.cleanup, internal=True)
+        # internal: driven by the node's periodic task.  Routed through the
+        # syncer so the observer's settled floor moves in the same owner
+        # step as the store's GC (see Syncer.cleanup).
+        return await self._call(self.syncer.cleanup, internal=True)
+
+    async def apply_snapshot(self, manifest) -> bool:
+        """Adopt a snapshot catch-up baseline (storage.py) on the owner —
+        commit-chain state and the observer's linearizer move together."""
+        return await self._call(self.syncer.apply_snapshot, manifest)
 
     async def get_missing(self) -> List[Set[BlockReference]]:
         # internal: driven by the synchronizer's periodic task.

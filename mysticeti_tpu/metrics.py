@@ -184,7 +184,23 @@ class Metrics:
         self.block_store_entries = counter("block_store_entries", "stored blocks")
         self.wal_mappings = gauge("wal_mappings", "live mmap windows")
         self.wal_size_bytes = gauge(
-            "wal_size_bytes", "write-ahead log file size"
+            "wal_size_bytes",
+            "live write-ahead log bytes across all surviving segments "
+            "(storage lifecycle: bounded by GC, not lifetime bytes written)",
+        )
+        # Storage lifecycle plane (storage.py).
+        self.wal_segments = gauge(
+            "wal_segments", "live WAL segment files (1 = single-file log)"
+        )
+        self.wal_reclaimed_bytes_total = counter(
+            "wal_reclaimed_bytes_total",
+            "WAL bytes deleted by segment garbage collection below the "
+            "retired round floor",
+        )
+        self.checkpoint_last_commit_index = gauge(
+            "checkpoint_last_commit_index",
+            "commit height anchoring the newest durable checkpoint "
+            "(recovery replays only WAL entries after it)",
         )
 
         # Core owner queue (core_lock_* in metrics.rs:51-53; the dispatcher

@@ -33,6 +33,9 @@ from .network import (
     Connection,
     RequestBlocks,
     RequestBlocksResponse,
+    RequestSnapshot,
+    RequestSnapshotStream,
+    SnapshotResponse,
     SubscribeOthersFrom,
     SubscribeOwnFrom,
 )
@@ -135,6 +138,11 @@ class NetworkSyncer:
         self._stopped = asyncio.Event()
         self._wal_sync_thread: Optional[threading.Thread] = None
         self._start_wal_sync_thread = start_wal_sync_thread
+        # Snapshot catch-up serving totals, surviving connection teardown
+        # (the per-connection disseminator dies with its peer): the artifact
+        # and tests read how much bootstrap data this node shipped.
+        self.snapshot_blocks_served = 0
+        self.snapshot_bytes_served = 0
 
     # -- lifecycle --
 
@@ -160,6 +168,7 @@ class NetworkSyncer:
         syncer = self.core.wal_syncer()
         stop = self._stopped
         size_gauge = self.metrics.wal_size_bytes if self.metrics else None
+        segments_gauge = self.metrics.wal_segments if self.metrics else None
         wal_writer = self.core.wal_writer
 
         def run():
@@ -172,9 +181,13 @@ class NetworkSyncer:
                 except OSError:
                     return
                 if size_gauge is not None:
-                    # The appender's position is the log's logical size;
+                    # Live bytes across every surviving segment — the old
+                    # single-file read (the append position) over-reports
+                    # by exactly the GC-reclaimed bytes once segments roll;
                     # sampled here so the gauge costs one set per second.
-                    size_gauge.set(wal_writer.position())
+                    size_gauge.set(wal_writer.size_bytes())
+                if segments_gauge is not None:
+                    segments_gauge.set(wal_writer.segment_count())
 
         self._wal_sync_thread = threading.Thread(
             target=run, name="wal-syncer", daemon=True
@@ -234,6 +247,12 @@ class NetworkSyncer:
         self._helper_subs.drop_authority(peer)
         if self.parameters.synchronizer.disseminate_others_blocks:
             await self._request_helper_streams(connection)
+        if self.parameters.storage.snapshot_catchup:
+            # Snapshot catch-up ask (storage.py): tell the peer our commit
+            # height; a peer far enough ahead answers with its manifest +
+            # the retained block window, anyone else ignores it.  Cheap (one
+            # small frame per connect) and self-gating on both sides.
+            await connection.send(RequestSnapshot(self.core.commit_height()))
         # Per-connection verification pipeline: the reader overlaps many
         # in-flight signature batches (the accelerator's round-trip would
         # otherwise serialize the connection at one batch per RTT), while the
@@ -246,6 +265,12 @@ class NetworkSyncer:
         # block back-to-back would get every copy signature-verified while the
         # first is still in flight.
         inflight: Set[bytes] = set()
+        # One-shot arming for the snapshot bulk stream: serving a manifest
+        # to this peer arms exactly one RequestSnapshotStream (re-arming
+        # requires another gap-checked RequestSnapshot), so a caught-up or
+        # misbehaving peer cannot turn the one-u64 ask into a repeated
+        # full-window push.
+        snapshot_armed_floor: Optional[int] = None
         accept_task = asyncio.ensure_future(
             self._accept_ordered(pipeline, connection, inflight)
         )
@@ -282,6 +307,41 @@ class NetworkSyncer:
                         except asyncio.CancelledError:
                             fut.cancel()
                             raise
+                elif isinstance(msg, RequestSnapshot):
+                    # Serving side: answer a genuinely far-behind peer with
+                    # the MANIFEST only (cheap — every connected server may
+                    # answer).  The bulk block window ships on an explicit
+                    # RequestSnapshotStream from the one peer that adopted
+                    # our manifest, so a rejoiner never receives N-1
+                    # redundant copies of the whole retained window.
+                    manifest = self.core.snapshot_manifest_for(
+                        msg.commit_height
+                    )
+                    if manifest is not None:
+                        log.info(
+                            "serving snapshot manifest to authority %d (its "
+                            "height %d, ours %d)", peer, msg.commit_height,
+                            manifest.commit_height,
+                        )
+                        snapshot_armed_floor = manifest.gc_round
+                        await connection.send(
+                            SnapshotResponse(manifest.to_bytes())
+                        )
+                elif isinstance(msg, RequestSnapshotStream):
+                    if (
+                        self.parameters.storage.snapshot_catchup
+                        and snapshot_armed_floor is not None
+                    ):
+                        # Serve from the floor we actually advertised (the
+                        # peer's value cannot widen the walk), and hold GC
+                        # so the window cannot be holed mid-stream.
+                        disseminator.stream_snapshot(
+                            max(msg.from_round, snapshot_armed_floor),
+                            gc_hold=self.core.storage,
+                        )
+                        snapshot_armed_floor = None
+                elif isinstance(msg, SnapshotResponse):
+                    await self._handle_snapshot_response(connection, msg)
                 elif isinstance(msg, RequestBlocks):
                     if self.metrics is not None:
                         self.metrics.block_sync_requests_received.labels(
@@ -317,6 +377,8 @@ class NetworkSyncer:
                 if item is not None:
                     item[0].cancel()
             disseminator.stop()
+            self.snapshot_blocks_served += disseminator.snapshot_blocks_sent
+            self.snapshot_bytes_served += disseminator.snapshot_bytes_sent
             self._disseminators.pop(peer, None)
             if self.connections.get(peer) is connection:
                 del self.connections[peer]
@@ -335,6 +397,36 @@ class NetworkSyncer:
                     live = self.connections.get(authority)
                     if live is None or live.is_closed():
                         self._ask_relays_for(authority)
+
+    async def _handle_snapshot_response(
+        self, connection: Connection, msg: SnapshotResponse
+    ) -> None:
+        """Client side of snapshot catch-up: decode the manifest and adopt
+        it on the consensus owner (which also releases any blocks already
+        parked on sub-floor parents).  Stale/duplicate manifests — every
+        connected peer may answer — are rejected by the owner's gap check;
+        only the ADOPTED manifest's sender is asked to stream the bulk
+        block window."""
+        from .storage import SnapshotManifest
+
+        if not self.parameters.storage.snapshot_catchup:
+            # We never asked: an unsolicited manifest with a huge baseline
+            # would otherwise poison the commit chain and raise the DAG
+            # floor on a node that opted out of catch-up entirely.
+            log.warning("ignoring unsolicited snapshot manifest from peer")
+            return
+        try:
+            manifest = SnapshotManifest.from_bytes(msg.manifest)
+        except Exception:  # noqa: BLE001 - byzantine peer: drop, don't die
+            log.warning("dropping malformed snapshot manifest from peer")
+            return
+        adopted = await self.dispatcher.apply_snapshot(manifest)
+        if adopted:
+            log.info(
+                "snapshot catch-up adopted: commit height %d, floor %d",
+                manifest.commit_height, manifest.gc_round,
+            )
+            await connection.send(RequestSnapshotStream(manifest.gc_round))
 
     def _ask_relays_for(self, authority: int) -> None:
         """Ask connected peers to relay ``authority``'s blocks (its direct

@@ -56,6 +56,9 @@ _MSG_NOT_FOUND = 5
 _MSG_PING = 6
 _MSG_PONG = 7
 _MSG_SUBSCRIBE_OTHERS = 8
+_MSG_REQUEST_SNAPSHOT = 9
+_MSG_SNAPSHOT = 10
+_MSG_REQUEST_SNAPSHOT_STREAM = 11
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +77,38 @@ class SubscribeOthersFrom:
 
     authority: int
     round: RoundNumber
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestSnapshot:
+    """Snapshot catch-up ask (storage.py): "my committed height is
+    ``commit_height``; if I am far behind, send me your commit baseline".
+    A soft wire extension per docs/wire-format.md §7 — only sent when
+    ``StorageParameters.snapshot_catchup`` is on; receivers that predate
+    the tag reset the connection."""
+
+    commit_height: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotResponse:
+    """The serving node's :class:`~mysticeti_tpu.storage.SnapshotManifest`
+    (opaque canonical bytes).  The block window itself is only shipped on an
+    explicit :class:`RequestSnapshotStream` — every qualifying peer answers
+    the ask with a manifest (cheap), but the receiver adopts exactly one and
+    pulls the bulk window from that peer alone."""
+
+    manifest: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestSnapshotStream:
+    """Post-adoption bulk ask: "stream me every block you hold from
+    ``from_round`` up" — sent to the ONE peer whose manifest was adopted;
+    the window arrives as ordinary ``Blocks`` frames, decoded and re-hashed
+    by the receiver like any push stream."""
+
+    from_round: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +170,12 @@ def encode_message(msg: NetworkMessage) -> bytes:
         w.u8(_MSG_PING).u64(msg.nanos)
     elif isinstance(msg, Pong):
         w.u8(_MSG_PONG).u64(msg.nanos)
+    elif isinstance(msg, RequestSnapshot):
+        w.u8(_MSG_REQUEST_SNAPSHOT).u64(msg.commit_height)
+    elif isinstance(msg, SnapshotResponse):
+        w.u8(_MSG_SNAPSHOT).bytes(msg.manifest)
+    elif isinstance(msg, RequestSnapshotStream):
+        w.u8(_MSG_REQUEST_SNAPSHOT_STREAM).u64(msg.from_round)
     else:  # pragma: no cover
         raise SerdeError(f"unknown message {type(msg)}")
     return w.finish()
@@ -159,6 +200,12 @@ def decode_message(data: bytes) -> NetworkMessage:
         msg = Ping(r.u64())
     elif tag == _MSG_PONG:
         msg = Pong(r.u64())
+    elif tag == _MSG_REQUEST_SNAPSHOT:
+        msg = RequestSnapshot(r.u64())
+    elif tag == _MSG_SNAPSHOT:
+        msg = SnapshotResponse(r.bytes())
+    elif tag == _MSG_REQUEST_SNAPSHOT_STREAM:
+        msg = RequestSnapshotStream(r.u64())
     else:
         raise SerdeError(f"unknown message tag {tag}")
     r.expect_done()

@@ -76,12 +76,28 @@ class CoreRecoveredState:
     state: Optional[bytes]
     unprocessed_blocks: List[StatementBlock]
     last_committed_leader: Optional[BlockReference]
+    # Storage-lifecycle baseline (storage.py): the commit chain as of the end
+    # of replay, and how much replay actually cost — checkpointed boots
+    # assert replayed_bytes << lifetime WAL bytes.
+    commit_height: int = 0
+    chain_digest: bytes = b""
+    gc_round: int = 0
+    replay_start: WalPosition = 0
+    replayed_bytes: int = 0
+    checkpoint_height: int = 0
 
 
 @dataclass
 class CommitObserverRecoveredState:
     sub_dags: List[CommitData] = field(default_factory=list)
     state: Optional[bytes] = None
+    # Checkpoint/snapshot baseline: the linearizer resumes at ``base_height``
+    # with ``base_committed`` already sequenced and everything below
+    # ``gc_round`` settled (storage.py).  ``sub_dags`` then carries only the
+    # commits replayed AFTER the baseline.
+    base_height: int = 0
+    base_committed: List[BlockReference] = field(default_factory=list)
+    gc_round: int = 0
 
 
 class RecoveredStateBuilder:
@@ -96,6 +112,55 @@ class RecoveredStateBuilder:
         self._last_committed_leader: Optional[BlockReference] = None
         self._committed_sub_dags: List[CommitData] = []
         self._committed_state: Optional[bytes] = None
+        # Storage-lifecycle chain state (storage.py): folded from the
+        # checkpoint/snapshot baseline plus every replayed commit entry.
+        self._commit_height = 0
+        self._chain_digest = b"\x00" * 32
+        self._gc_round = 0
+        self._base_height = 0
+        self._base_committed: List[BlockReference] = []
+        self._checkpoint_height = 0
+        self._replay_start: WalPosition = 0
+        self._replayed_bytes = 0
+
+    def seed_checkpoint(self, checkpoint) -> None:
+        """Boot the fold from a durable checkpoint instead of genesis: the
+        pending queue, own proposal, handler state, and commit baseline come
+        from the checkpoint; replay then starts at its WAL position."""
+        self._pending = dict(checkpoint.pending)
+        self._last_own_block = checkpoint.last_own_block
+        self._state = checkpoint.handler_state
+        self._last_committed_leader = checkpoint.last_committed_leader
+        self._committed_state = checkpoint.committed_state
+        self._commit_height = checkpoint.commit_height
+        self._chain_digest = checkpoint.chain_digest
+        self._gc_round = checkpoint.gc_round
+        self._base_height = checkpoint.commit_height
+        self._base_committed = list(checkpoint.committed_refs)
+        self._checkpoint_height = checkpoint.commit_height
+        self._replay_start = checkpoint.wal_position
+
+    def snapshot(self, manifest) -> None:
+        """Fold a persisted snapshot-adoption entry (WAL_ENTRY_SNAPSHOT): the
+        node adopted a remote commit baseline mid-run; recovery must resume
+        from the SAME baseline, and every commit folded before the adoption
+        sits below it (the observer must not re-deliver them)."""
+        self._last_committed_leader = manifest.last_committed_leader
+        self._commit_height = manifest.commit_height
+        self._chain_digest = manifest.chain_digest
+        self._gc_round = max(self._gc_round, manifest.gc_round)
+        self._base_height = manifest.commit_height
+        self._base_committed = list(manifest.committed_refs)
+        self._committed_sub_dags = []
+
+    def note_replayed(self, replayed_bytes: int) -> None:
+        self._replayed_bytes = replayed_bytes
+
+    def note_retired_floor(self, floor: int) -> None:
+        """Blocks below ``floor`` are known-gone (their segments were GC'd
+        after the recovering checkpoint was written): the recovered DAG
+        floor must cover them so nothing re-fetches settled history."""
+        self._gc_round = max(self._gc_round, floor)
 
     def block(self, pos: WalPosition, block: StatementBlock) -> None:
         self._pending[pos] = Include(block.reference)
@@ -118,11 +183,17 @@ class RecoveredStateBuilder:
         self._unprocessed_blocks.clear()
 
     def commit_data(self, commits: List[CommitData], committed_state: bytes) -> None:
+        from .storage import fold_leader_digest
+
         for commit in commits:
             self._last_committed_leader = commit.leader
             if self._committed_sub_dags:
                 assert commit.height > self._committed_sub_dags[-1].height
             self._committed_sub_dags.append(commit)
+            self._commit_height = commit.height
+            self._chain_digest = fold_leader_digest(
+                self._chain_digest, commit.leader
+            )
         self._committed_state = committed_state
 
     def build(
@@ -138,9 +209,18 @@ class RecoveredStateBuilder:
             state=self._state,
             unprocessed_blocks=self._unprocessed_blocks,
             last_committed_leader=self._last_committed_leader,
+            commit_height=self._commit_height,
+            chain_digest=self._chain_digest,
+            gc_round=self._gc_round,
+            replay_start=self._replay_start,
+            replayed_bytes=self._replayed_bytes,
+            checkpoint_height=self._checkpoint_height,
         )
         observer = CommitObserverRecoveredState(
             sub_dags=self._committed_sub_dags,
             state=self._committed_state,
+            base_height=self._base_height,
+            base_committed=self._base_committed,
+            gc_round=self._gc_round,
         )
         return core, observer

@@ -23,7 +23,6 @@ import os
 from typing import List, Optional, Tuple
 
 from .block_handler import BenchmarkFastPathBlockHandler, SimpleBlockHandler
-from .block_store import BlockStore
 from .block_validator import (
     AcceptAllBlockVerifier,
     BatchedSignatureVerifier,
@@ -44,7 +43,6 @@ from .network import TcpNetwork
 
 log = logger(__name__)
 from .transactions_generator import TransactionGenerator
-from .wal import walf
 
 
 class CommitConsumer:
@@ -183,13 +181,23 @@ class Validator:
             probe.attach_critical_path(tracer)
         self.health = probe.start(self.HEALTH_INTERVAL_S)
 
-    # -- storage (validator.rs:334-352) --
+    # -- storage (validator.rs:334-352 + the storage lifecycle plane) --
 
     @staticmethod
-    def init_storage(authority: int, committee: Committee, private: PrivateConfig):
-        wal_writer, wal_reader = walf(private.wal())
-        return BlockStore.open(authority, wal_reader, wal_writer, committee) + (
-            wal_writer,
+    def init_storage(
+        authority: int,
+        committee: Committee,
+        private: PrivateConfig,
+        parameters: Optional[Parameters] = None,
+        metrics=None,
+    ):
+        """Segmented WAL + checkpoint-seeded recovery (storage.py): boots
+        from the newest valid checkpoint and replays only what follows it.
+        Returns ``(recovered, observer_recovered, wal_writer, lifecycle)``."""
+        from .storage import open_store
+
+        return open_store(
+            authority, private.wal(), committee, parameters, metrics
         )
 
     # -- benchmarking node (validator.rs:78-163) --
@@ -213,8 +221,8 @@ class Validator:
         current_authority.set(authority)
         log.info("starting benchmarking validator %d (verifier=%s)", authority, verifier)
         v.metrics = Metrics()
-        (recovered, observer_recovered, wal_writer) = cls.init_storage(
-            authority, committee, private
+        (recovered, observer_recovered, wal_writer, lifecycle) = cls.init_storage(
+            authority, committee, private, parameters, v.metrics
         )
         handler = BenchmarkFastPathBlockHandler(
             committee,
@@ -235,6 +243,7 @@ class Validator:
             options=CoreOptions(fsync=False),
             signer=signer,
             metrics=v.metrics,
+            storage=lifecycle,
         )
         v.core = core
         observer = TestCommitObserver(
@@ -303,8 +312,8 @@ class Validator:
         current_authority.set(authority)
         log.info("starting production validator %d (verifier=%s)", authority, verifier)
         v.metrics = Metrics()
-        (recovered, observer_recovered, wal_writer) = cls.init_storage(
-            authority, committee, private
+        (recovered, observer_recovered, wal_writer, lifecycle) = cls.init_storage(
+            authority, committee, private, parameters, v.metrics
         )
         handler = SimpleBlockHandler()
         core = Core(
@@ -317,6 +326,7 @@ class Validator:
             options=CoreOptions.production(),
             signer=signer,
             metrics=v.metrics,
+            storage=lifecycle,
         )
         v.core = core
         consumer = commit_consumer or CommitConsumer()

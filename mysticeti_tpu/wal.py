@@ -252,6 +252,21 @@ class WalWriter:
     def position(self) -> WalPosition:
         return self._pos
 
+    def size_bytes(self) -> int:
+        """Live log bytes.  For the single-file log this IS the append
+        position; the segmented WAL (storage.py) overrides it to sum the
+        surviving segments so the ``wal_size_bytes`` gauge reflects disk
+        actually held, not lifetime bytes written."""
+        return self._pos
+
+    def segment_count(self) -> int:
+        return 1
+
+    def note_round(self, round_: int, position: Optional[WalPosition] = None) -> None:
+        """Lifecycle hook: the segmented writer (storage.py) tracks the max
+        block round per segment as its GC predicate; the single-file log has
+        no segments to retire, so this is a no-op."""
+
     def sync(self) -> None:
         self.flush()
         os.fsync(self._fd)
@@ -424,6 +439,39 @@ class WalReader:
             if header is None:
                 return
             crc, length, tag = header
+            if pos + HEADER_SIZE + length > end:
+                return
+            try:
+                tag2, payload = self.read(pos)
+            except WalError:
+                return
+            yield pos, tag2, payload
+            pos += HEADER_SIZE + length
+
+    def iter_from(
+        self, start: WalPosition, end: Optional[WalPosition] = None
+    ) -> Iterator[Tuple[WalPosition, Tag, bytes]]:
+        """Replay entries from ``start`` (an entry boundary) up to ``end``.
+
+        Checkpoint recovery (storage.py) resumes replay at the position the
+        checkpoint recorded instead of byte zero.  Same torn-tail contract as
+        :meth:`iter_until`; a ``start`` that is not a valid entry boundary
+        yields nothing (the caller's replayed-end accounting then treats
+        everything past it as torn).
+        """
+        if start == 0:
+            yield from self.iter_until(end)
+            return
+        if self._writer_flush is not None:
+            self._writer_flush()
+        if end is None:
+            end = os.fstat(self._fd).st_size
+        pos: WalPosition = start
+        while pos + HEADER_SIZE <= end:
+            header = self._read_header(pos)
+            if header is None:
+                return
+            _crc, length, tag = header
             if pos + HEADER_SIZE + length > end:
                 return
             try:

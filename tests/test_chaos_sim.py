@@ -175,9 +175,12 @@ def test_torn_tail_recovery_mid_sim(tmp_path):
     # Rejoined and committed past its pre-crash height.
     assert harness.checker.committed_height(2) > event["committed_height"]
     # Recovery truncated the tear before the first post-restart append: the
-    # final WAL replays entry-by-entry to EXACTLY the end of file — no torn
-    # bytes left behind, no unreplayable gap.
-    path = os.path.join(str(tmp_path), "wal-2")
+    # final ACTIVE segment (where the tear landed and appends resumed)
+    # replays entry-by-entry to EXACTLY the end of file — no torn bytes left
+    # behind, no unreplayable gap.
+    from mysticeti_tpu.storage import active_wal_file
+
+    path = active_wal_file(os.path.join(str(tmp_path), "wal-2"))
     reader = WalReader(path)
     end = 0
     for pos, _tag, payload in reader.iter_until():
