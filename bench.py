@@ -505,6 +505,22 @@ def _emit(value: float, used: dict = None) -> None:
         # full-config measurement in the recorded artifact.
         record.update(used)
     print(json.dumps(record))
+    # Perf-trend plane: every live measurement (zero-records included)
+    # lands in the append-only BENCH_TREND.json index so the bench
+    # trajectory can never be empty (tools/bench_trend.py; BENCH_TREND=0
+    # or an unwritable index silently skips — diagnostics must not break
+    # the measurement).
+    if os.environ.get("BENCH_TREND") != "0":
+        try:
+            from tools.bench_trend import append_record
+
+            append_record(record, path=os.environ.get(
+                "BENCH_TREND_PATH",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_TREND.json"),
+            ))
+        except Exception:
+            pass
 
 
 if __name__ == "__main__":

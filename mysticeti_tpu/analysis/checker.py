@@ -95,6 +95,10 @@ GUARDED_FIELDS: Dict[str, str] = {
     # out/in from any executor thread; the live-connection count must move
     # with the deque under one lock or the bound drifts.
     "_pool_size": "_pool_lock",
+    # Flight-recorder event ring (flight_recorder.py): appended from the
+    # loop thread while the metrics endpoint / a signal path snapshots it —
+    # any reassignment (resize, swap) must happen under the ring lock.
+    "_flight_ring": "_ring_lock",
     # Segmented WAL manifest table (storage.py): the segment list is
     # rewritten by the appender on roll/GC/tear-truncation and read by the
     # paired reader, the metrics sampler, and the fsync thread — every

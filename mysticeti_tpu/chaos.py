@@ -50,6 +50,7 @@ from .commit_observer import TestCommitObserver
 from .committee import Committee
 from .config import Parameters
 from .core import Core, CoreOptions
+from .flight_recorder import FlightRecorder
 from .health import FleetHealthMonitor, HealthProbe, SLOThresholds
 from .metrics import Metrics
 from .net_sync import NetworkSyncer
@@ -450,13 +451,24 @@ class ChaosSimHarness:
         self.sim_net = SimulatedNetwork(n)
         self.nodes: List[Optional[NetworkSyncer]] = [None] * n
         self.down: Set[int] = set()
+        # Flight recorders: one ring per authority, SURVIVING restarts like
+        # the probes (the forensic window must span the crash) — memory-only
+        # here; ``run_chaos_sim`` dumps every live node's ring the moment
+        # the SafetyChecker fails.
+        self.recorders: Dict[int, FlightRecorder] = {
+            a: FlightRecorder(authority=a, metrics=self.metrics[a])
+            for a in range(n)
+        }
         # Health plane: one probe per authority, SURVIVING restarts (rate
         # state and the alert stream span a node's whole life); a central
         # loop-clocked monitor samples them so same-seed runs produce a
         # byte-identical health timeline.
         self.probes: Dict[int, HealthProbe] = (
             {
-                a: HealthProbe(a, n, metrics=self.metrics[a], slo=slo)
+                a: HealthProbe(
+                    a, n, metrics=self.metrics[a], slo=slo,
+                    recorder=self.recorders[a],
+                )
                 for a in range(n)
             }
             if slo is not None
@@ -502,6 +514,10 @@ class ChaosSimHarness:
             self.committee,
             recovered_state=observer_recovered,
         )
+        recorder = self.recorders[authority]
+        observer.recorder = recorder
+        if lifecycle is not None:
+            lifecycle.recorder = recorder
         verifier = (
             self.verifier_factory(
                 authority, self.committee, self.metrics[authority]
@@ -516,6 +532,7 @@ class ChaosSimHarness:
             parameters=self.parameters,
             block_verifier=verifier,
             metrics=self.metrics[authority],
+            recorder=recorder,
         )
         probe = self.probes.get(authority)
         if probe is not None:
@@ -540,6 +557,9 @@ class ChaosSimHarness:
         node = self.nodes[authority]
         assert node is not None, f"authority {authority} is already down"
         self.down.add(authority)
+        self.recorders[authority].record(
+            "crash", torn_tail_bytes=torn_tail_bytes
+        )
         probe = self.probes.get(authority)
         if probe is not None:
             probe.detach()  # sampled as {"down": true} until restart
@@ -564,6 +584,7 @@ class ChaosSimHarness:
 
     async def restart(self, authority: int) -> NetworkSyncer:
         assert authority in self.down, f"authority {authority} is not down"
+        self.recorders[authority].record("restart")
         node = self._build_node(authority)  # WAL replay happens here
         self.nodes[authority] = node
         await node.start()
@@ -761,6 +782,11 @@ class ChaosReport:
     health_timeline: List[dict] = field(default_factory=list)
     health_timeline_bytes: bytes = b""
     slo_alerts: List[dict] = field(default_factory=list)
+    # Flight recorders: every node's canonical event-ring dump (byte-
+    # identical across same-seed runs).  On a safety FAILURE the sim never
+    # reaches this report — the rings land on disk instead
+    # (``flight-recorder-<authority>.json`` next to the WALs).
+    recorder_dumps: Dict[int, bytes] = field(default_factory=dict)
 
     def schedule_digest(self) -> str:
         return hashlib.sha256(self.fault_log_bytes).hexdigest()
@@ -810,7 +836,21 @@ def run_chaos_sim(
         if extra is not None:
             extra.cancel()
         await harness.stop()
-        harness.checker.check()
+        try:
+            harness.checker.check()
+        except SafetyViolation:
+            # The flight recorder's reason to exist: the moment commit
+            # safety fails, every LIVE node's event ring is dumped next to
+            # the WALs (crashed nodes have no live ring to preserve — their
+            # last dumpable state is whatever a restart rebuilt).
+            for a in range(harness.n):
+                if a in harness.down:
+                    continue
+                harness.recorders[a].dump(
+                    "safety-failure",
+                    path=os.path.join(wal_dir, f"flight-recorder-{a}.json"),
+                )
+            raise
         monitor = harness.health_monitor
         return ChaosReport(
             sequences=harness.sequences(),
@@ -824,6 +864,10 @@ def run_chaos_sim(
                 monitor.timeline_bytes() if monitor else b""
             ),
             slo_alerts=monitor.alert_stream() if monitor else [],
+            recorder_dumps={
+                a: harness.recorders[a].snapshot_bytes()
+                for a in range(harness.n)
+            },
         )
 
     return run_simulation(main(), seed=plan.seed), harness

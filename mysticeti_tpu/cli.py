@@ -63,8 +63,9 @@ def benchmark_genesis(
 
 
 def _apply_storage_overrides(parameters: Parameters, args) -> None:
-    """CLI storage-lifecycle flags override the parameters file (run) or the
-    generated genesis (testbed): one knob block, one override path."""
+    """CLI storage-lifecycle + tracing flags override the parameters file
+    (run) or the generated genesis (testbed): one knob block, one override
+    path."""
     storage = parameters.storage
     if getattr(args, "gc_depth", None) is not None:
         storage.gc_depth = args.gc_depth
@@ -74,6 +75,8 @@ def _apply_storage_overrides(parameters: Parameters, args) -> None:
         storage.checkpoint_interval = args.checkpoint_interval
     if getattr(args, "snapshot_catchup", False):
         storage.snapshot_catchup = True
+    if getattr(args, "timestamp_frames", False):
+        parameters.synchronizer.timestamp_frames = True
 
 
 async def run_node(
@@ -227,6 +230,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="arm the snapshot catch-up streams (wire tags "
                        "9/10/11): far-behind peers bootstrap from a commit "
                        "baseline + recent block window, not full history")
+        p.add_argument("--timestamp-frames", action="store_true",
+                       help="stamp block push frames with sender clocks "
+                       "(wire tag 12): peers surface per-link transit and "
+                       "the fleet-trace merger can align cross-node clocks "
+                       "(docs/fleet-tracing.md)")
 
     r = sub.add_parser("run", help="run one validator")
     r.add_argument("--authority", type=int, required=True)

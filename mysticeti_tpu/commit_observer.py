@@ -42,6 +42,22 @@ def _trace_committed(tracer, t0: float, committed, authority) -> None:
 
 
 class CommitObserver:
+    # Flight recorder (flight_recorder.py), wired post-construction by the
+    # node assembly: one "commit" edge per handle_commit batch — the block
+    # lifecycle signal the incident ring keeps, at commit (not per-block)
+    # granularity.
+    recorder = None
+
+    def _record_committed(self, committed: List[CommittedSubDag]) -> None:
+        if self.recorder is not None and committed:
+            last = committed[-1]
+            self.recorder.record(
+                "commit",
+                height=last.height,
+                sub_dags=len(committed),
+                anchor=spans.format_ref(last.anchor),
+            )
+
     def handle_commit(
         self, committed_leaders: List[StatementBlock]
     ) -> List[CommittedSubDag]:
@@ -193,6 +209,7 @@ class TestCommitObserver(CommitObserver):
                 committed,
                 self.commit_interpreter.block_store.authority,
             )
+        self._record_committed(committed)
         return committed
 
     def _update_metrics_batch(self, heads: bytes, now: float) -> None:
@@ -258,6 +275,7 @@ class SimpleCommitObserver(CommitObserver):
             self.sender(commit)
         if tracer is not None:
             _trace_committed(tracer, t0, committed, self.block_store.authority)
+        self._record_committed(committed)
         return committed
 
     def aggregator_state(self) -> bytes:

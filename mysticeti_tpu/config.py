@@ -54,6 +54,13 @@ class SynchronizerParameters:
     stream_interval_s: float = 1.0
     new_stream_threshold: int = 10
     disseminate_others_blocks: bool = False
+    # Stamp outgoing block push frames with the sender's monotonic+wall
+    # clocks (wire tag 12, docs/wire-format.md §5): the receiver surfaces
+    # per-link transit (dissemination_transit_seconds{peer}) and records
+    # `transit` spans the fleet-trace merger's skew estimator aligns.  Off
+    # by default — like the other soft tags, pre-knob receivers reset the
+    # connection on it.
+    timestamp_frames: bool = False
 
 
 @dataclass

@@ -249,6 +249,7 @@ class Core:
         assert includes
         from .runtime import timestamp_utc
 
+        t_propose = spans.SpanTracer.now()
         block = StatementBlock.build(
             self.authority,
             clock_round,
@@ -275,6 +276,13 @@ class Core:
             )
         tracer = spans.active()
         if tracer is not None:
+            # The journey's t=0 (tools/fleet_trace.py): the author built and
+            # signed the block here — every peer's transit/receive measures
+            # from this edge once traces are merged.
+            tracer.record_span(
+                "propose", block.reference, t_propose,
+                authority=self.authority,
+            )
             # Own blocks skip receive/verify/dag_add; their pipeline starts
             # at the wait for commit.
             tracer.begin_span(

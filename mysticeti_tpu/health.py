@@ -236,12 +236,20 @@ class HealthProbe:
         metrics=None,
         slo: Optional[SLOThresholds] = None,
         clock: Callable[[], float] = runtime_now,
+        recorder=None,
     ) -> None:
         self.authority = authority
         self.committee_size = committee_size
         self.metrics = metrics
         self.slo = slo or SLOThresholds()
         self.clock = clock
+        # Flight recorder (flight_recorder.py): alert edges and verifier
+        # breaker/pin transitions land in the node's event ring; an alert
+        # additionally triggers a debounced on-disk dump when the recorder
+        # has a path.
+        self.recorder = recorder
+        self._last_breaker_open: Optional[bool] = None
+        self._last_pinned: Optional[bool] = None
         self.alerts: List[Alert] = []
         self.critical_path: Optional[CriticalPathAnalyzer] = None
         self._core = None
@@ -356,6 +364,21 @@ class HealthProbe:
         if state_fn is not None:
             verifier_state = state_fn()
         breaker_open = bool(verifier_state and verifier_state["breaker_open"])
+        if self.recorder is not None and verifier_state is not None:
+            pinned = bool(verifier_state.get("pinned_backend"))
+            if self._last_breaker_open is not None and (
+                breaker_open != self._last_breaker_open
+            ):
+                self.recorder.record(
+                    "breaker", open=breaker_open
+                )
+            if self._last_pinned is not None and pinned != self._last_pinned:
+                self.recorder.record(
+                    "pin", pinned=pinned,
+                    backend=verifier_state.get("pinned_backend"),
+                )
+            self._last_breaker_open = breaker_open
+            self._last_pinned = pinned
         self._breaker_samples.append(1 if breaker_open else 0)
         if len(self._breaker_samples) > self.BREAKER_WINDOW:
             self._breaker_samples.pop(0)
@@ -448,6 +471,10 @@ class HealthProbe:
                         "" if authority is None else str(authority),
                         alert.stage,
                     ).inc()
+                if self.recorder is not None:
+                    self.recorder.on_alert(
+                        kind, authority, alert.stage, alert.value, detail
+                    )
             elif not violated:
                 self._firing.discard(key)
 

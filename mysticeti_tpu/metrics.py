@@ -248,6 +248,22 @@ class Metrics:
             "connection_latency", "peer rtt", labels=("peer",),
             buckets=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0],
         )
+        # Fleet causal trace plane (spans.py + tools/fleet_trace.py).
+        self.dissemination_transit_seconds = histogram(
+            "dissemination_transit_seconds",
+            "one-way wire transit of block push frames from each peer, "
+            "measured from the tag-12 sender timestamp (clamped at zero; "
+            "the raw signed value rides in the trace for skew estimation)",
+            labels=("peer",),
+            buckets=[0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     5.0],
+        )
+        self.flight_recorder_dumps_total = counter(
+            "flight_recorder_dumps_total",
+            "flight-recorder ring dumps written, by trigger (shutdown, "
+            "slo-alert, safety-failure)",
+            labels=("trigger",),
+        )
 
         # TPU verifier.
         self.verified_signatures_total = counter(
@@ -536,12 +552,15 @@ class MetricReporter:
 
 
 async def serve_metrics(metrics: Metrics, host: str, port: int,
-                        health_probe=None):
+                        health_probe=None, flight_recorder=None):
     """Minimal asyncio HTTP endpoint (prometheus.rs:31-49): ``/metrics`` for
     the scraper, ``/healthz`` (200 + uptime) for liveness probes, and — when
     a :class:`~mysticeti_tpu.health.HealthProbe` is wired — ``/health``, the
     readiness/diagnosis JSON document (503 while an SLO alert is firing, so
-    the route doubles as a readiness gate)."""
+    the route doubles as a readiness gate).  With a
+    :class:`~mysticeti_tpu.flight_recorder.FlightRecorder` wired,
+    ``/debug/flight-recorder`` serves the live event-ring dump (the same
+    canonical document the SIGTERM/alert dumps write)."""
     import json as _json
 
     started = time.monotonic()
@@ -561,6 +580,12 @@ async def serve_metrics(metrics: Metrics, host: str, port: int,
                     '{"status":"ok","uptime_s":%.3f}\n'
                     % (time.monotonic() - started)
                 ).encode()
+                content_type = b"application/json"
+            elif (
+                path.split("?", 1)[0] == "/debug/flight-recorder"
+                and flight_recorder is not None
+            ):
+                body = flight_recorder.snapshot_bytes() + b"\n"
                 content_type = b"application/json"
             elif path.split("?", 1)[0] == "/health" and health_probe is not None:
                 doc = health_probe.diagnosis()

@@ -38,6 +38,8 @@ from .network import (
     SnapshotResponse,
     SubscribeOthersFrom,
     SubscribeOwnFrom,
+    TimestampedBlocks,
+    wall_jump_us,
 )
 from .syncer import Syncer, SyncerSignals
 from .tracing import logger
@@ -48,6 +50,12 @@ from .synchronizer import BlockDisseminator, BlockFetcher, HelperSubscriptions
 from .types import AuthoritySet, StatementBlock, VerificationError
 
 CLEANUP_INTERVAL_S = 10.0
+
+# Sender stamp pairs whose wall/monotonic deltas disagree by more than this
+# mean the peer's wall clock stepped between frames (see network.wall_jump_us)
+# — generous against NTP slew over the 1 s stream cadence, tight against
+# actual steps.
+WALL_JUMP_TOLERANCE_US = 50_000
 
 
 class Notify:
@@ -99,6 +107,7 @@ class NetworkSyncer:
         block_verifier: Optional[BlockVerifier] = None,
         metrics=None,
         start_wal_sync_thread: bool = False,
+        recorder=None,
     ) -> None:
         self.parameters = parameters or Parameters()
         self.signals = AsyncSignals()
@@ -143,6 +152,14 @@ class NetworkSyncer:
         # and tests read how much bootstrap data this node shipped.
         self.snapshot_blocks_served = 0
         self.snapshot_bytes_served = 0
+        # Flight recorder (flight_recorder.py): connection churn, leader
+        # timeouts, and sync decisions are exactly the "seconds before the
+        # incident" events its ring exists for.  None = not recording.
+        self.recorder = recorder
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.record(kind, **fields)
 
     # -- lifecycle --
 
@@ -229,6 +246,7 @@ class NetworkSyncer:
         """net_sync.rs:237-312."""
         peer = connection.peer
         log.debug("connection established with authority %d", peer)
+        self._record("peer-connect", peer=peer)
         self.connections[peer] = connection
         self.connected_authorities.insert(peer)
         disseminator = BlockDisseminator(
@@ -265,6 +283,8 @@ class NetworkSyncer:
         # block back-to-back would get every copy signature-verified while the
         # first is still in flight.
         inflight: Set[bytes] = set()
+        # Last sender stamp pair per tag-12 frame (wall-jump detection).
+        last_stamp: Optional[tuple] = None
         # One-shot arming for the snapshot bulk stream: serving a manifest
         # to this peer arms exactly one RequestSnapshotStream (re-arming
         # requires another gap-checked RequestSnapshot), so a caught-up or
@@ -289,7 +309,47 @@ class NetworkSyncer:
                         msg.authority, msg.round
                     )
                 elif isinstance(msg, (Blocks, RequestBlocksResponse)):
-                    verified = await self._decode_fresh(msg.blocks)
+                    transit = None
+                    if (
+                        isinstance(msg, TimestampedBlocks)
+                        and msg.sent_wall_ns
+                    ):
+                        # Wire-timestamp extension (tag 12): raw transit is
+                        # SIGNED (clock skew can drive it negative) — the
+                        # histogram clamps, the trace keeps the raw value
+                        # for the fleet merger's skew estimator.  The
+                        # monotonic stamp detects a sender wall-clock STEP
+                        # between frames: that frame's wall-derived transit
+                        # is garbage and is dropped (log once per step).
+                        from .runtime import timestamp_utc
+
+                        stamp = (msg.sent_monotonic_ns, msg.sent_wall_ns)
+                        jumped = (
+                            last_stamp is not None
+                            and wall_jump_us(last_stamp, stamp)
+                            > WALL_JUMP_TOLERANCE_US
+                        )
+                        last_stamp = stamp
+                        if jumped:
+                            log.warning(
+                                "authority %d wall clock stepped between "
+                                "frames; dropping transit sample", peer,
+                            )
+                        else:
+                            raw_s = (
+                                timestamp_utc() - msg.sent_wall_ns / 1e9
+                            )
+                            rtt_s = connection.latency()
+                            if rtt_s == float("inf"):
+                                rtt_s = None
+                            if self.metrics is not None:
+                                self.metrics.dissemination_transit_seconds.labels(
+                                    str(peer)
+                                ).observe(max(0.0, raw_s))
+                            transit = (peer, raw_s, rtt_s)
+                    verified = await self._decode_fresh(
+                        msg.blocks, transit=transit
+                    )
                     verified = [
                         b for b in verified
                         if b.reference.digest not in inflight
@@ -323,6 +383,11 @@ class NetworkSyncer:
                             "height %d, ours %d)", peer, msg.commit_height,
                             manifest.commit_height,
                         )
+                        self._record(
+                            "snapshot-served", peer=peer,
+                            peer_height=msg.commit_height,
+                            height=manifest.commit_height,
+                        )
                         snapshot_armed_floor = manifest.gc_round
                         await connection.send(
                             SnapshotResponse(manifest.to_bytes())
@@ -355,6 +420,7 @@ class NetworkSyncer:
                         )
         finally:
             log.debug("connection to authority %d closed", peer)
+            self._record("peer-disconnect", peer=peer)
             # Drain what already entered the pipeline, then stop the acceptor.
             # If this task is itself being cancelled (node stop), don't wait —
             # cancel the acceptor instead of hanging in the finally.
@@ -426,6 +492,10 @@ class NetworkSyncer:
                 "snapshot catch-up adopted: commit height %d, floor %d",
                 manifest.commit_height, manifest.gc_round,
             )
+            self._record(
+                "snapshot-adopted", peer=connection.peer,
+                height=manifest.commit_height, floor=manifest.gc_round,
+            )
             await connection.send(RequestSnapshotStream(manifest.gc_round))
 
     def _ask_relays_for(self, authority: int) -> None:
@@ -439,6 +509,7 @@ class NetworkSyncer:
                 continue
             if conn.try_send(SubscribeOthersFrom(authority, last_seen)):
                 self._helper_subs.note_asked(authority, helper)
+                self._record("helper-ask", authority=authority, helper=helper)
 
     async def _request_helper_streams(self, connection: Connection) -> None:
         """On a fresh connection: ask it to relay every authority we have
@@ -455,6 +526,9 @@ class NetworkSyncer:
             last_seen = self.core.block_store.last_seen_by_authority(authority)
             await connection.send(SubscribeOthersFrom(authority, last_seen))
             self._helper_subs.note_asked(authority, connection.peer)
+            self._record(
+                "helper-ask", authority=authority, helper=connection.peer
+            )
 
     async def _accept_ordered(
         self, pipeline: asyncio.Queue, connection, inflight: Set[bytes]
@@ -478,9 +552,14 @@ class NetworkSyncer:
 
     # -- the receive pipeline (net_sync.rs:314-386), three stages --
 
-    async def _decode_fresh(self, serialized_blocks) -> List[StatementBlock]:
+    async def _decode_fresh(
+        self, serialized_blocks, transit=None
+    ) -> List[StatementBlock]:
         """Stage 1 (host, fast): parse, dedup via the core task, consensus-
-        rule checks."""
+        rule checks.  ``transit`` is ``(src peer, raw signed transit s,
+        rtt s or None)`` when the frame rode the timestamp extension — each
+        fresh block then gets a ``transit`` span whose args carry the link
+        and the raw value for the fleet merger's skew estimator."""
         tracer = spans.active()
         t_recv = tracer.now() if tracer is not None else 0.0
         timer = self._utilization_timer
@@ -521,6 +600,17 @@ class NetworkSyncer:
                         str(block.author())
                     ).observe(max(0.0, now - created / 1e9))
         if tracer is not None:
+            if transit is not None and verified:
+                src, raw_s, rtt_s = transit
+                extra = {"src": src, "raw_us": int(round(raw_s * 1e6))}
+                if rtt_s is not None:
+                    extra["rtt_us"] = int(round(rtt_s * 1e6))
+                t0_transit = t_recv - max(0.0, raw_s)
+                for block in verified:
+                    tracer.record_span(
+                        "transit", block.reference, t0_transit, t1=t_recv,
+                        authority=self.core.authority, extra=extra,
+                    )
             for block in verified:
                 tracer.record_span(
                     "receive", block.reference, t_recv,
@@ -612,6 +702,7 @@ class NetworkSyncer:
                 log.debug(
                     "leader timeout at round %d: forcing proposal", round_at_start
                 )
+                self._record("leader-timeout", round=round_at_start)
                 try:
                     await self.dispatcher.force_new_block(
                         round_at_start + 1, self.connected_authorities.copy()

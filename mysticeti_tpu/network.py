@@ -59,6 +59,7 @@ _MSG_SUBSCRIBE_OTHERS = 8
 _MSG_REQUEST_SNAPSHOT = 9
 _MSG_SNAPSHOT = 10
 _MSG_REQUEST_SNAPSHOT_STREAM = 11
+_MSG_BLOCKS_TIMESTAMPED = 12
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +118,36 @@ class Blocks:
 
 
 @dataclasses.dataclass(frozen=True)
+class TimestampedBlocks(Blocks):
+    """A ``Blocks`` push frame stamped with the sender's clocks at send time
+    (fleet causal tracing, tools/fleet_trace.py): ``sent_monotonic_ns`` is
+    the sender's runtime clock (detects wall-clock jumps between frames),
+    ``sent_wall_ns`` its wall clock — the receiver's arrival time minus it
+    is the RAW per-link transit the skew estimator aligns.  A soft wire
+    extension per docs/wire-format.md §7 (tag 12): receivers that predate
+    the tag reset the connection, so senders only emit it when
+    ``SynchronizerParameters.timestamp_frames`` is on.  Subclasses
+    ``Blocks`` so every receive path handles it unchanged."""
+
+    sent_monotonic_ns: int = 0
+    sent_wall_ns: int = 0
+
+
+def wall_jump_us(prev: Tuple[int, int], cur: Tuple[int, int]) -> int:
+    """|Δwall − Δmonotonic| between two consecutive sender stamp pairs
+    ``(sent_monotonic_ns, sent_wall_ns)``, in microseconds.
+
+    Between frames both sender clocks advance by real elapsed time, so the
+    two deltas agree to within slew; a large disagreement means the
+    sender's WALL clock stepped (NTP jump) between the frames — the
+    receiver must discard that frame's wall-derived transit sample, which
+    is the reason the monotonic stamp rides the wire at all."""
+    dw = cur[1] - prev[1]
+    dm = cur[0] - prev[0]
+    return abs(dw - dm) // 1000
+
+
+@dataclasses.dataclass(frozen=True)
 class RequestBlocks:
     references: Tuple[BlockReference, ...]
 
@@ -150,6 +181,13 @@ def encode_message(msg: NetworkMessage) -> bytes:
         w.u8(_MSG_SUBSCRIBE).u64(msg.round)
     elif isinstance(msg, SubscribeOthersFrom):
         w.u8(_MSG_SUBSCRIBE_OTHERS).u64(msg.authority).u64(msg.round)
+    elif isinstance(msg, TimestampedBlocks):
+        # Before the Blocks branch: a TimestampedBlocks IS a Blocks.
+        w.u8(_MSG_BLOCKS_TIMESTAMPED)
+        w.u64(msg.sent_monotonic_ns).u64(msg.sent_wall_ns)
+        w.u32(len(msg.blocks))
+        for b in msg.blocks:
+            w.bytes(b)
     elif isinstance(msg, Blocks):
         w.u8(_MSG_BLOCKS).u32(len(msg.blocks))
         for b in msg.blocks:
@@ -206,6 +244,13 @@ def decode_message(data: bytes) -> NetworkMessage:
         msg = SnapshotResponse(r.bytes())
     elif tag == _MSG_REQUEST_SNAPSHOT_STREAM:
         msg = RequestSnapshotStream(r.u64())
+    elif tag == _MSG_BLOCKS_TIMESTAMPED:
+        monotonic_ns, wall_ns = r.u64(), r.u64()
+        msg = TimestampedBlocks(
+            tuple(r.bytes() for _ in range(r.u32())),
+            sent_monotonic_ns=monotonic_ns,
+            sent_wall_ns=wall_ns,
+        )
     else:
         raise SerdeError(f"unknown message tag {tag}")
     r.expect_done()

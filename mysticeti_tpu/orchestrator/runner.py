@@ -47,12 +47,13 @@ class Runner:
         raise NotImplementedError
 
 
-async def _http_get_metrics(host: str, port: int, timeout: float = 5.0) -> Optional[str]:
+async def _http_get_metrics(host: str, port: int, timeout: float = 5.0,
+                            path: str = "/metrics") -> Optional[str]:
     try:
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port), timeout=timeout
         )
-        writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
         await writer.drain()
         data = await asyncio.wait_for(reader.read(-1), timeout=timeout)
         writer.close()

@@ -52,6 +52,12 @@ from .tracing import current_authority
 # parses this assignment (it must stay a literal tuple of strings) and flags
 # any span call whose stage is not registered.
 STAGES = (
+    # Fleet-trace stages (tools/fleet_trace.py): the author's proposal edge
+    # (the journey's t=0) and the per-link wire transit measured from the
+    # timestamped-frame extension (wire tag 12) — args carry the sending
+    # peer and the RAW signed transit the skew estimator consumes.
+    "propose",
+    "transit",
     "receive",
     "verify",
     "verify_dispatch",
@@ -109,8 +115,16 @@ class SpanTracer:
         flush_path: Optional[str] = None,
         flush_every_s: float = 5.0,
     ) -> None:
-        # Completed spans: (stage, ref label, authority, t0, t1).
-        self._events: List[Tuple[str, str, Optional[int], float, float]] = []
+        # Completed spans: (stage, ref label, authority, t0, t1, extra args).
+        self._events: List[Tuple[str, str, Optional[int], float, float,
+                                 Optional[dict]]] = []
+        # Clock anchor for cross-node trace merging (tools/fleet_trace.py):
+        # one (runtime, wall) pair captured at the FIRST recorded span, on
+        # the recording thread — the merger converts each trace's runtime
+        # timestamps to wall time through it.  Captured once (not per
+        # flush) so a seeded sim's exported bytes stay a pure function of
+        # the seed.
+        self._anchor: Optional[Tuple[float, float]] = None
         # Open spans: (stage, ref, authority) -> t0.
         self._open: Dict[Tuple[str, object, Optional[int]], float] = {}
         # Live subscribers called with (stage, ref, authority, t0, t1) for
@@ -158,18 +172,28 @@ class SpanTracer:
         t0: float,
         t1: Optional[float] = None,
         authority: Optional[int] = None,
+        extra: Optional[dict] = None,
     ) -> None:
-        """Append a completed span measured by the caller."""
+        """Append a completed span measured by the caller.  ``extra`` lands
+        in the exported event's ``args`` (next to the block label) — the
+        ``transit`` stage uses it to carry the sending peer and the raw
+        signed transit for the skew estimator."""
         if authority is None:
             authority = current_authority.get()
         if t1 is None:
             t1 = runtime_now()
         self._notify(stage, ref, authority, t0, t1)
         with self._lock:
+            if self._anchor is None:
+                from .runtime import timestamp_utc
+
+                self._anchor = (runtime_now(), timestamp_utc())
             if len(self._events) >= self.MAX_EVENTS:
                 self.dropped += 1
                 return
-            self._events.append((stage, format_ref(ref), authority, t0, t1))
+            self._events.append(
+                (stage, format_ref(ref), authority, t0, t1, extra)
+            )
 
     def begin_span(
         self,
@@ -211,10 +235,16 @@ class SpanTracer:
             t0 = self._open.pop(key, None)
             if t0 is None:
                 return
+            if self._anchor is None:
+                from .runtime import timestamp_utc
+
+                self._anchor = (runtime_now(), timestamp_utc())
             if len(self._events) >= self.MAX_EVENTS:
                 self.dropped += 1
             else:
-                self._events.append((stage, format_ref(ref), authority, t0, t))
+                self._events.append(
+                    (stage, format_ref(ref), authority, t0, t, None)
+                )
         self._notify(stage, ref, authority, t0, t)
 
     @contextmanager
@@ -239,12 +269,13 @@ class SpanTracer:
         pid = os.getpid()
         with self._lock:
             events = list(self._events)
+            anchor = self._anchor
 
         def tid_of(authority: Optional[int]) -> int:
             return _UNTRACKED_TID if authority is None else authority
 
         tids = {}
-        for _, _, authority, _, _ in events:
+        for _, _, authority, _, _, _ in events:
             tid = tid_of(authority)
             tids[tid] = "untracked" if authority is None else f"A{authority}"
         trace_events = [
@@ -267,7 +298,11 @@ class SpanTracer:
             )
         spans = [
             {
-                "args": {"block": label},
+                "args": (
+                    {"block": label}
+                    if not extra
+                    else {"block": label, **extra}
+                ),
                 "cat": "pipeline",
                 "dur": max(0, round((t1 - t0) * 1e6)),
                 "name": stage,
@@ -276,11 +311,20 @@ class SpanTracer:
                 "tid": tid_of(authority),
                 "ts": round(t0 * 1e6),
             }
-            for stage, label, authority, t0, t1 in events
+            for stage, label, authority, t0, t1, extra in events
         ]
         spans.sort(key=lambda e: (e["ts"], e["tid"], e["name"], e["args"]["block"], e["dur"]))
         trace_events.extend(spans)
-        return {"displayTimeUnit": "ms", "traceEvents": trace_events}
+        trace = {"displayTimeUnit": "ms", "traceEvents": trace_events}
+        if anchor is not None:
+            # Cross-node merge anchor (tools/fleet_trace.py): the same
+            # instant on the trace's runtime clock and the wall clock,
+            # microseconds.  Virtual-deterministic under the simulator.
+            trace["otherData"] = {
+                "clock_runtime_us": round(anchor[0] * 1e6),
+                "clock_wall_us": round(anchor[1] * 1e6),
+            }
+        return trace
 
     def write(self, path: str) -> None:
         """Atomic write (tmp + rename): a SIGKILL landing mid-flush must not
@@ -370,3 +414,129 @@ def stop_from_env() -> None:
         return
     _active.stop()
     _active = None
+
+
+# ---------------------------------------------------------------------------
+# Shared trace loading + stage extraction (tools/trace_report.py AND
+# tools/fleet_trace.py).  One implementation on purpose: the two consumers
+# used to carry their own copies of the salvage/extraction logic, and a
+# trace tail truncated mid-flush could land on different stage boundaries in
+# each — the critical-path report and the fleet merge then disagreed about
+# the same file.
+
+
+def salvage_trace_events(text: str) -> List[dict]:
+    """Recover complete event objects from a truncated trace: find the
+    traceEvents array and raw-decode objects one at a time until the tear."""
+    start = text.find('"traceEvents"')
+    if start < 0:
+        return []
+    start = text.find("[", start)
+    if start < 0:
+        return []
+    decoder = json.JSONDecoder()
+    events: List[dict] = []
+    pos = start + 1
+    n = len(text)
+    while pos < n:
+        while pos < n and text[pos] in " \t\r\n,":
+            pos += 1
+        if pos >= n or text[pos] == "]":
+            break
+        try:
+            event, pos = decoder.raw_decode(text, pos)
+        except ValueError:
+            break  # the tear: everything before it is intact
+        if isinstance(event, dict):
+            events.append(event)
+    return events
+
+
+def _salvage_other_data(text: str) -> dict:
+    """The clock anchor survives most tears (sort_keys puts ``otherData``
+    before ``traceEvents`` in our own exports); best-effort recover it."""
+    start = text.find('"otherData"')
+    if start < 0:
+        return {}
+    start = text.find("{", start + len('"otherData"'))
+    if start < 0:
+        return {}
+    try:
+        other, _ = json.JSONDecoder().raw_decode(text, start)
+    except ValueError:
+        return {}
+    return other if isinstance(other, dict) else {}
+
+
+def load_trace_events(path: str):
+    """All events from a Chrome trace-event JSON file.
+
+    Returns ``(events, note, other_data)``: a truncated/mid-flush tail is
+    tolerated by salvaging the complete events before the tear (reported
+    through ``note``); ``other_data`` carries the clock anchor when present.
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except ValueError:
+        events = salvage_trace_events(text)
+        note = (
+            f"note: trace is truncated (mid-flush tail?); salvaged "
+            f"{len(events)} complete event(s)"
+        )
+        return events, note, _salvage_other_data(text)
+    if isinstance(data, dict):
+        events = data.get("traceEvents")
+        if not isinstance(events, list):
+            return [], "note: no traceEvents array in trace", {}
+        return events, "", data.get("otherData") or {}
+    if isinstance(data, list):
+        return data, "", {}
+    return [], "note: unrecognized trace shape", {}
+
+
+def complete_spans(events: List[dict]) -> List[dict]:
+    """Complete ("X") span events."""
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def track_names(events: List[dict]) -> Dict[Tuple[int, int], str]:
+    """(pid, tid) -> track name from the thread_name metadata events."""
+    return {
+        (e.get("pid", 0), e.get("tid", 0)): e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+
+
+def stage_chains(
+    span_events: List[dict], stages: Optional[Tuple[str, ...]] = None
+) -> Dict[Tuple[Tuple[int, int], str], Dict[str, Tuple[int, int]]]:
+    """Per-block stage chains: ``(track=(pid, tid), block label) ->
+    {stage: (first ts µs, max dur µs)}``.
+
+    The ONE extraction rule both offline consumers share: duplicate spans
+    for the same (track, block, stage) — retransmits, flush overlap —
+    keep the EARLIEST start and the LONGEST duration.  ``stages`` filters
+    which span names participate (default: every registered stage).
+    """
+    allowed = set(stages if stages is not None else STAGES)
+    chains: Dict[Tuple[Tuple[int, int], str], Dict[str, Tuple[int, int]]] = {}
+    for e in span_events:
+        name = e.get("name")
+        if name not in allowed:
+            continue
+        label = (e.get("args") or {}).get("block")
+        if not label:
+            continue
+        track = (e.get("pid", 0), e.get("tid", 0))
+        ts = e.get("ts", 0)
+        dur = e.get("dur", 0)
+        entry = chains.setdefault((track, label), {})
+        prev = entry.get(name)
+        if prev is None:
+            entry[name] = (ts, dur)
+        else:
+            entry[name] = (min(prev[0], ts), max(prev[1], dur))
+    return chains

@@ -930,6 +930,10 @@ class StorageLifecycle:
         # event loop): GC must not advance the floor under a window a
         # manifest already promised.
         self.gc_holds = 0
+        # Flight recorder (flight_recorder.py), wired post-construction by
+        # the node assembly: GC passes and checkpoint writes are incident-
+        # ring events.
+        self.recorder = None
         # Boot-cost evidence (the acceptance criterion "replay bytes <<
         # lifetime WAL bytes"): how much replay this boot actually paid.
         self.replay_start = recovered.replay_start
@@ -1036,6 +1040,11 @@ class StorageLifecycle:
         self.checkpoints_written += 1
         if self.metrics is not None:
             self.metrics.checkpoint_last_commit_index.set(self.commit_height)
+        if self.recorder is not None:
+            self.recorder.record(
+                "checkpoint", height=self.commit_height,
+                wal_position=ckpt.wal_position,
+            )
         log.info(
             "checkpoint at commit height %d (wal position %d, %d index "
             "entries)", self.commit_height, ckpt.wal_position, len(ckpt.index),
@@ -1080,6 +1089,10 @@ class StorageLifecycle:
             if reclaimed:
                 self.metrics.wal_reclaimed_bytes_total.inc(reclaimed)
             self.metrics.wal_segments.set(self._segment_count())
+        if self.recorder is not None:
+            self.recorder.record(
+                "gc", floor=target, reclaimed_bytes=reclaimed
+            )
         return reclaimed
 
     # -- snapshot catch-up --

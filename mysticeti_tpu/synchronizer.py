@@ -25,6 +25,7 @@ from .network import (
     Connection,
     RequestBlocks,
     RequestBlocksResponse,
+    TimestampedBlocks,
 )
 from .types import BlockReference, RoundNumber
 
@@ -66,6 +67,21 @@ class BlockDisseminator:
         self._snapshot_task: Optional[asyncio.Task] = None
         self.snapshot_blocks_sent = 0
         self.snapshot_bytes_sent = 0
+
+    def _blocks_message(self, payload) -> Blocks:
+        """Push-frame constructor: plain ``Blocks``, or — when the
+        ``timestamp_frames`` knob is on — a :class:`TimestampedBlocks`
+        stamped with the sender's runtime+wall clocks (both virtual under
+        the deterministic simulator, so stamped sims stay reproducible)."""
+        if not self.parameters.timestamp_frames:
+            return Blocks(payload)
+        from .runtime import now as runtime_now, timestamp_utc
+
+        return TimestampedBlocks(
+            payload,
+            sent_monotonic_ns=int(runtime_now() * 1e9),
+            sent_wall_ns=int(timestamp_utc() * 1e9),
+        )
 
     def subscribe_own_from(self, from_round: RoundNumber) -> None:
         """Peer asked for our blocks starting after ``from_round``."""
@@ -114,7 +130,7 @@ class BlockDisseminator:
                 cursor = max(b.round() for b in blocks)
                 self.helper_blocks_sent += len(blocks)
                 await self.connection.send(
-                    Blocks(tuple(b.to_bytes() for b in blocks))
+                    self._blocks_message(tuple(b.to_bytes() for b in blocks))
                 )
             else:
                 try:
@@ -136,7 +152,7 @@ class BlockDisseminator:
             if blocks:
                 cursor = max(b.round() for b in blocks)
                 await self.connection.send(
-                    Blocks(tuple(b.to_bytes() for b in blocks))
+                    self._blocks_message(tuple(b.to_bytes() for b in blocks))
                 )
             else:
                 try:

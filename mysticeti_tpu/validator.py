@@ -35,6 +35,7 @@ from .committee import Committee
 from .config import Parameters, PrivateConfig
 from .core import Core, CoreOptions
 from .crypto import Signer
+from .flight_recorder import FlightRecorder, path_from_env
 from .health import HealthProbe, SLOThresholds
 from .metrics import MetricReporter, Metrics, serve_metrics
 from .net_sync import NetworkSyncer
@@ -150,6 +151,21 @@ class Validator:
         self._metrics_server = None
         self.core: Optional[Core] = None
         self.health: Optional[HealthProbe] = None
+        self.recorder: Optional[FlightRecorder] = None
+
+    def _make_recorder(self, authority: int, lifecycle, observer):
+        """The always-on flight recorder: ring in memory unconditionally,
+        on-disk dumps when ``MYSTICETI_FLIGHT_RECORDER`` names a path."""
+        recorder = FlightRecorder(
+            authority=authority,
+            dump_path=path_from_env(authority),
+            metrics=self.metrics,
+        )
+        if lifecycle is not None:
+            lifecycle.recorder = recorder
+        observer.recorder = recorder
+        self.recorder = recorder
+        return recorder
 
     def _start_health(self, authority, committee, observer, block_verifier):
         """Wire the fleet health plane: probe + SLO watchdog + (when span
@@ -169,6 +185,7 @@ class Validator:
                 ),
                 max_breaker_open_fraction=0.5,
             ),
+            recorder=self.recorder,
         )
         probe.attach(
             core=self.core,
@@ -257,6 +274,7 @@ class Validator:
         transaction_size = int(
             os.environ.get("TRANSACTION_SIZE", str(transaction_size))
         )
+        recorder = v._make_recorder(authority, lifecycle, observer)
         block_verifier = _make_verifier(verifier, committee, v.metrics)
         v.generator = TransactionGenerator(
             submit=handler.submit,
@@ -281,6 +299,7 @@ class Validator:
             block_verifier=block_verifier,
             metrics=v.metrics,
             start_wal_sync_thread=True,
+            recorder=recorder,
         )
         await v.network_syncer.start()
         v.generator.start()
@@ -289,7 +308,8 @@ class Validator:
         if serve_metrics_endpoint and parameters.identifiers:
             host, port = parameters.metrics_address(authority)
             v._metrics_server = await serve_metrics(
-                v.metrics, "0.0.0.0", port, health_probe=v.health
+                v.metrics, "0.0.0.0", port, health_probe=v.health,
+                flight_recorder=recorder,
             )
         return v
 
@@ -344,6 +364,7 @@ class Validator:
                 metrics=v.metrics,
                 max_latency_s=parameters.network_connection_max_latency_s,
             )
+        recorder = v._make_recorder(authority, lifecycle, observer)
         block_verifier = _make_verifier(verifier, committee, v.metrics)
         v.network_syncer = NetworkSyncer(
             core,
@@ -353,6 +374,7 @@ class Validator:
             block_verifier=block_verifier,
             metrics=v.metrics,
             start_wal_sync_thread=True,
+            recorder=recorder,
         )
         await v.network_syncer.start()
         v.reporter = MetricReporter(v.metrics).start()
@@ -377,6 +399,11 @@ class Validator:
         from . import spans
 
         spans.flush_active()
+        # Flight-recorder tail: SIGTERM routes here too (the node CLI's
+        # handler), so an operator-stopped node always leaves its incident
+        # ring on disk when MYSTICETI_FLIGHT_RECORDER is set.
+        if self.recorder is not None and self.recorder.dump_path:
+            self.recorder.dump("shutdown")
         if self.core is not None:
             self.core.wal_writer.close()
             # Release the WAL reader too (fd + whole-file mmap): embeddings

@@ -20,6 +20,9 @@ def bench(monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     monkeypatch.setenv("BENCH_ACCOUNTING", "0")
+    # Ladder tests must not append their synthetic measurements to the
+    # repo's live perf-trend index (tools/bench_trend.py).
+    monkeypatch.setenv("BENCH_TREND", "0")
     monkeypatch.delenv("BENCH_WORKER", raising=False)
     return mod
 

@@ -1,0 +1,353 @@
+#!/usr/bin/env python3
+"""Perf-trend plane: normalize every bench artifact into one trajectory.
+
+Five rounds of perf artifacts accumulated at the repo root with five
+slightly different schemas (``BENCH_r01`` is ``{parsed: {...}}``,
+``MAXLOAD_r02`` is flat, ``MAXLOAD_TPU_r03`` nests ``fleet_runs``,
+``TENNODE_r05`` has a ``runs`` list, and failed rounds carry
+``parsed: null``) — so the perf STORY was only readable by a human diffing
+JSON by hand, and nothing could say "this round regressed".  This tool:
+
+* **normalizes** every ``BENCH_*.json`` / ``MAXLOAD_*`` / ``TENNODE_*``
+  artifact (tolerating the r1-r5 schema drift and ``parsed: null``
+  records) into flat ``{round, source, metric, value, unit}`` records;
+* maintains the **append-only** ``BENCH_TREND.json`` index (records are
+  deduplicated by (source, metric, seq); re-running over the same
+  artifacts is idempotent, new artifacts and live ``bench.py`` appends
+  accumulate);
+* prints the **terminal regression report** — per metric, the value by
+  round with the delta vs the best prior round — and exits **non-zero
+  (2) when the newest round of any metric regressed >10%** vs the best
+  prior round, so CI and the driver can gate on the trajectory;
+* is wired into ``bench.py``: every live run appends its measurement via
+  :func:`append_record`, so the trajectory can never be empty again.
+
+Usage:
+    python tools/bench_trend.py                     # scan repo root, update index, report
+    python tools/bench_trend.py --repo /path --out BENCH_TREND.json
+    python tools/bench_trend.py --no-write          # report only
+    python tools/bench_trend.py --tolerance 0.2     # custom regression gate
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+ARTIFACT_GLOBS = ("BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json")
+
+# >10% below the best prior round fails the gate.
+DEFAULT_TOLERANCE = 0.10
+
+
+def _record(round_, source, metric, value, unit, **extra) -> dict:
+    rec = {
+        "round": round_,
+        "source": source,
+        "metric": metric,
+        "value": None if value is None else round(float(value), 3),
+        "unit": unit,
+    }
+    rec.update(extra)
+    return rec
+
+
+def normalize(path: str) -> List[dict]:
+    """Flatten one artifact into trend records.  Unknown shapes yield an
+    unparsed marker record rather than nothing — the trajectory must show
+    that a round produced an artifact even when it cannot score it."""
+    source = os.path.basename(path)
+    match = _ROUND_RE.search(source)
+    round_ = int(match.group(1)) if match else None
+    # The artifact FAMILY (MAXLOAD vs MAXLOAD_TPU vs TENNODE ...) namespaces
+    # the fleet metrics: different families measure different
+    # configurations, and comparing e.g. a TPU-fleet peak against a CPU
+    # search would make the regression gate fire on configuration
+    # differences instead of regressions.
+    family = re.sub(r"_r\d+\.json$", "", source)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [_record(round_, source, "unparsed", None, "",
+                        note=f"unreadable: {exc}")]
+    if not isinstance(doc, dict):
+        return [_record(round_, source, "unparsed", None, "",
+                        note="unrecognized shape")]
+    out: List[dict] = []
+
+    # BENCH_rNN: driver wrapper {n, cmd, rc, tail, parsed: {...}|null}.
+    if "parsed" in doc:
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("value") is not None:
+            out.append(_record(
+                round_, source, parsed.get("metric", "bench"),
+                parsed["value"], parsed.get("unit", ""),
+                vs_baseline=parsed.get("vs_baseline"),
+            ))
+        else:
+            # parsed=null: the round ran and failed — record the failure so
+            # the trajectory shows the gap instead of silently skipping it.
+            out.append(_record(
+                round_, source, "ed25519_verifies_per_sec", None, "sig/s",
+                note=f"parsed=null (rc={doc.get('rc')})",
+            ))
+        return out
+
+    # bench.py direct record (also what append_record receives).
+    if doc.get("metric") == "ed25519_verifies_per_sec" and "value" in doc:
+        out.append(_record(round_, source, doc["metric"], doc["value"],
+                           doc.get("unit", "sig/s"),
+                           vs_baseline=doc.get("vs_baseline")))
+        return out
+
+    # BENCH_SAMPLES_rNN: all-day samples; score the round by its best and
+    # worst sample (the spread IS the story there).
+    samples = doc.get("samples_utc")
+    if isinstance(samples, list) and samples:
+        values = [s.get("value") for s in samples if s.get("value") is not None]
+        if values:
+            out.append(_record(round_, source, "bench_samples_best",
+                               max(values), "sig/s", samples=len(values)))
+            out.append(_record(round_, source, "bench_samples_worst",
+                               min(values), "sig/s", samples=len(values)))
+        return out
+
+    def fleet_run(rec: dict, prefix: str) -> None:
+        """One orchestrator fleet-run blob, wherever it is nested."""
+        for key, metric in (
+            ("max_sustainable_load_tx_s", "max_sustainable_load_tx_s"),
+            ("peak_committed_tx_s", "peak_committed_tx_s"),
+            ("committed_tx_s", "committed_tx_s"),
+        ):
+            if rec.get(key) is not None:
+                out.append(_record(
+                    round_, source, f"{prefix}{metric}", rec[key], "tx/s",
+                    verifier=rec.get("verifier"), nodes=rec.get("nodes"),
+                ))
+
+    # MAXLOAD_TAX: same-window A/B.
+    if "tpu_over_cpu" in doc:
+        for key, unit in (
+            ("cpu_peak_committed_tx_s", "tx/s"),
+            ("tpu_peak_committed_tx_s", "tx/s"),
+            ("tpu_over_cpu", "ratio"),
+        ):
+            if doc.get(key) is not None:
+                out.append(_record(round_, source, key, doc[key], unit))
+        return out
+
+    # MAXLOAD_TPU: nested fleet_runs {name: run}.
+    if isinstance(doc.get("fleet_runs"), dict):
+        for name, rec in sorted(doc["fleet_runs"].items()):
+            if isinstance(rec, dict):
+                fleet_run(rec, f"{family}.{name}.")
+        return out
+
+    # TENNODE_r05-style: runs list — score the best committed rate.
+    if isinstance(doc.get("runs"), list) and doc["runs"]:
+        best = max(
+            (r for r in doc["runs"] if isinstance(r, dict)),
+            key=lambda r: r.get("committed_tx_s") or 0.0,
+            default=None,
+        )
+        if best is not None:
+            fleet_run(best, f"{family}.")
+        return out
+
+    # Flat orchestrator record (MAXLOAD_r02, TENNODE_r02).
+    fleet_run(doc, f"{family}.")
+    if out:
+        return out
+    return [_record(round_, source, "unparsed", None, "",
+                    note="unrecognized shape")]
+
+
+# ---------------------------------------------------------------------------
+# The append-only index
+
+
+def load_index(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and isinstance(doc.get("records"), list):
+            return doc
+    except (OSError, ValueError):
+        pass
+    return {"kind": "mysticeti-bench-trend", "records": []}
+
+
+def _key(rec: dict) -> tuple:
+    return (rec.get("source"), rec.get("metric"), rec.get("seq"))
+
+
+def merge_index(index: dict, fresh: List[dict]) -> int:
+    """Append records not already present (append-only: existing entries are
+    never rewritten, so live bench.py appends survive re-scans)."""
+    seen = {_key(r) for r in index["records"]}
+    added = 0
+    for rec in fresh:
+        if _key(rec) in seen:
+            continue
+        index["records"].append(rec)
+        seen.add(_key(rec))
+        added += 1
+    return added
+
+
+def write_index(index: dict, path: str) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def append_record(record: dict, path: Optional[str] = None) -> None:
+    """Live append from bench.py: one measurement lands in the trend index
+    the moment it is produced (``seq`` makes repeated live runs distinct
+    where artifact records dedup by (source, metric)).  Never raises — the
+    trend plane must not be able to break a measurement."""
+    try:
+        path = path or os.environ.get("BENCH_TREND_PATH", "BENCH_TREND.json")
+        index = load_index(path)
+        seq = 1 + sum(
+            1 for r in index["records"] if r.get("source") == "bench.py(live)"
+        )
+        rec = _record(
+            None, "bench.py(live)", record.get("metric", "bench"),
+            record.get("value"), record.get("unit", ""),
+            vs_baseline=record.get("vs_baseline"), seq=seq,
+        )
+        index["records"].append(rec)
+        write_index(index, path)
+    except Exception:  # noqa: BLE001 - diagnostics only, never fail the bench
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The regression report
+
+
+def trajectory(records: List[dict]) -> Dict[str, List[dict]]:
+    """Per metric: the scored records in round order (unscored dropped)."""
+    by_metric: Dict[str, List[dict]] = defaultdict(list)
+    for rec in records:
+        if rec.get("value") is None:
+            continue
+        by_metric[rec["metric"]].append(rec)
+    for recs in by_metric.values():
+        recs.sort(key=lambda r: (
+            r["round"] if r.get("round") is not None else 1 << 30,
+            r.get("seq") or 0,
+            r["source"],
+        ))
+    return dict(by_metric)
+
+
+def regression_report(records: List[dict], tolerance: float):
+    """Returns (lines, regressions): per-metric round-by-round values with
+    the delta vs the best PRIOR round; a metric whose newest round sits
+    >tolerance below its best prior round is a regression."""
+    by_metric = trajectory(records)
+    lines: List[str] = []
+    regressions: List[str] = []
+    rounds_parsed = {
+        rec["round"]
+        for recs in by_metric.values()
+        for rec in recs
+        if rec.get("round") is not None
+    }
+    lines.append(
+        f"bench trend: {sum(len(v) for v in by_metric.values())} scored "
+        f"record(s), {len(by_metric)} metric(s), "
+        f"{len(rounds_parsed)} round(s) parsed"
+    )
+    for metric in sorted(by_metric):
+        recs = by_metric[metric]
+        lines.append("")
+        lines.append(f"{metric}:")
+        best_prior: Optional[float] = None
+        for rec in recs:
+            value = rec["value"]
+            label = (
+                f"r{rec['round']:02d}" if rec.get("round") is not None
+                else f"live#{rec.get('seq', '?')}"
+            )
+            delta = ""
+            if best_prior is not None and best_prior > 0:
+                pct = (value - best_prior) / best_prior * 100
+                delta = f"  {pct:+7.1f}% vs best prior"
+            lines.append(
+                f"  {label:<8}{value:>14,.1f} {rec.get('unit', ''):<6}"
+                f"{delta}  [{rec['source']}]"
+            )
+            best_prior = value if best_prior is None else max(best_prior, value)
+        # Gate on the NEWEST ROUND only (history is context, not a
+        # verdict).  Live bench.py appends (round=None) are shown above
+        # but never gate: a casual laptop run or a documented zero-record
+        # must not flip CI red against a real round's number.
+        rounds = [r for r in recs if r.get("round") is not None]
+        if len(rounds) >= 2:
+            latest = rounds[-1]["value"]
+            prior_best = max(r["value"] for r in rounds[:-1])
+            if prior_best > 0 and latest < prior_best * (1 - tolerance):
+                regressions.append(
+                    f"{metric}: latest {latest:,.1f} is "
+                    f"{(prior_best - latest) / prior_best * 100:.1f}% below "
+                    f"best prior {prior_best:,.1f} ({rounds[-1]['source']})"
+                )
+    if regressions:
+        lines.append("")
+        lines.append(f"REGRESSIONS (> {tolerance * 100:.0f}% below best prior round):")
+        for line in regressions:
+            lines.append(f"  {line}")
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_trend", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ), help="directory holding the round artifacts (default: repo root)")
+    parser.add_argument("--out", default=None,
+                        help="trend index path (default: <repo>/BENCH_TREND.json)")
+    parser.add_argument("--no-write", action="store_true",
+                        help="report only; do not update the index")
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                        help="regression gate: fail when the newest round "
+                        "is more than this fraction below the best prior")
+    args = parser.parse_args(argv)
+    out = args.out or os.path.join(args.repo, "BENCH_TREND.json")
+
+    fresh: List[dict] = []
+    for pattern in ARTIFACT_GLOBS:
+        for path in sorted(glob.glob(os.path.join(args.repo, pattern))):
+            if os.path.abspath(path) == os.path.abspath(out):
+                continue
+            fresh.extend(normalize(path))
+    index = load_index(out)
+    added = merge_index(index, fresh)
+    if not args.no_write:
+        write_index(index, out)
+        print(f"{out}: {added} new record(s), "
+              f"{len(index['records'])} total", file=sys.stderr)
+
+    lines, regressions = regression_report(index["records"], args.tolerance)
+    print("\n".join(lines))
+    return 2 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -70,6 +70,48 @@ async def scrape_all(targets) -> Dict[str, Optional[str]]:
     return {str(i): text for i, text in enumerate(texts)}
 
 
+async def fetch_recorders(targets) -> Dict[str, Optional[dict]]:
+    """Every node's live flight-recorder document (flight_recorder.py) from
+    the ``/debug/flight-recorder`` route; None for unreachable nodes or
+    pre-r9 nodes without the route."""
+    texts = await asyncio.gather(
+        *(
+            _http_get_metrics(host, port, path="/debug/flight-recorder")
+            for host, port in targets
+        )
+    )
+    docs: Dict[str, Optional[dict]] = {}
+    for i, text in enumerate(texts):
+        doc = None
+        if text:
+            try:
+                parsed = json.loads(text)
+                if isinstance(parsed, dict) and "events" in parsed:
+                    doc = parsed
+            except ValueError:
+                pass
+        docs[str(i)] = doc
+    return docs
+
+
+def recorder_summary(
+    docs: Dict[str, Optional[dict]], last: int = 10
+) -> Dict[str, Optional[dict]]:
+    """The artifact-embedded view: last N events + dump ledger per node."""
+    out: Dict[str, Optional[dict]] = {}
+    for node, doc in sorted(docs.items()):
+        if doc is None:
+            out[node] = None
+            continue
+        out[node] = {
+            "recorded": doc.get("recorded"),
+            "dropped": doc.get("dropped"),
+            "last_events": (doc.get("events") or [])[-last:],
+            "dumps": doc.get("dumps") or [],
+        }
+    return out
+
+
 def weather_sample(sampler) -> Optional[dict]:
     if sampler is None:
         return None
@@ -148,6 +190,45 @@ async def run(args) -> int:
     started = time.time()
     tick = 0
     last_snapshot: Optional[dict] = None
+    recorders: Dict[str, Optional[dict]] = {}
+    dump_paths: List[str] = []
+
+    def artifact_doc() -> dict:
+        return {
+            "targets": [f"{h}:{p}" for h, p in targets],
+            "interval_s": args.interval,
+            "window_utc": [round(started, 1), round(time.time(), 1)],
+            "slo": slo.to_dict(),
+            "dropped_ticks": dropped_ticks,
+            # Flight-recorder summary (flight_recorder.py): the last few
+            # incident-ring events per node + each node's dump ledger.
+            # Refreshed on the first tick, on every red transition, and at
+            # exit — NOT per tick: the debug route returns the FULL ring
+            # (up to ~1 MB/node) and polling it continuously would cost
+            # megabytes per interval to keep a 10-event slice fresh.
+            "flight_recorder": recorder_summary(recorders),
+            "flight_recorder_dumps": dump_paths,
+            "timeline": timeline,
+        }
+
+    async def write_red_dumps() -> None:
+        """Preserve every node's full incident ring on disk NOW — the
+        operator's first question is "what happened in the seconds before
+        red", and that window rolls off the bounded ring."""
+        nonlocal recorders
+        recorders = await fetch_recorders(targets)
+        base = args.out or "fleetmon.json"
+        for node, doc in sorted(recorders.items()):
+            if doc is None:
+                continue
+            path = f"{base}.flight-{node}.json"
+            atomic_write(path, doc)
+            if os.path.basename(path) not in dump_paths:
+                dump_paths.append(os.path.basename(path))
+            print(f"flight recorder of node {node} dumped to {path}",
+                  file=sys.stderr)
+
+    prev_degraded = False
     while True:
         tick += 1
         texts = await scrape_all(targets)
@@ -161,18 +242,18 @@ async def run(args) -> int:
             timeline.pop(0)
             dropped_ticks += 1
         last_snapshot = snapshot
+        degraded_now = snapshot["status"] != "ok"
+        if degraded_now and not prev_degraded and args.dump_on_red:
+            # Dump AT the red transition, mid-run included: a fleet that
+            # goes red at minute 10 of an hour-long watch must not wait
+            # for loop exit (the ring would have rolled past the incident,
+            # or a recovery would skip the dump entirely).
+            await write_red_dumps()
+        elif tick == 1:
+            recorders = await fetch_recorders(targets)
+        prev_degraded = degraded_now
         if args.out:
-            atomic_write(
-                args.out,
-                {
-                    "targets": [f"{h}:{p}" for h, p in targets],
-                    "interval_s": args.interval,
-                    "window_utc": [round(started, 1), round(time.time(), 1)],
-                    "slo": slo.to_dict(),
-                    "dropped_ticks": dropped_ticks,
-                    "timeline": timeline,
-                },
-            )
+            atomic_write(args.out, artifact_doc())
         if not args.no_dashboard:
             frame = render_dashboard(snapshot, targets, tick)
             sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
@@ -184,7 +265,17 @@ async def run(args) -> int:
         await asyncio.sleep(args.interval)
     if args.no_dashboard and last_snapshot is not None:
         print(render_dashboard(last_snapshot, targets, tick))
-    return 0 if last_snapshot and last_snapshot["status"] == "ok" else 3
+    degraded = not (last_snapshot and last_snapshot["status"] == "ok")
+    if degraded and args.dump_on_red:
+        # Exit while red: refresh the dumps so the gate failure always
+        # leaves the freshest rings (idempotent if the transition already
+        # dumped this red period).
+        await write_red_dumps()
+    else:
+        recorders = await fetch_recorders(targets)
+    if args.out:
+        atomic_write(args.out, artifact_doc())
+    return 3 if degraded else 0
 
 
 def main(argv=None) -> int:
@@ -210,6 +301,10 @@ def main(argv=None) -> int:
                         "memory/on disk (oldest roll off; default = 4h at "
                         "the 5s interval)")
     parser.add_argument("--no-dashboard", action="store_true")
+    parser.add_argument("--dump-on-red", action="store_true",
+                        help="when the readiness gate fails, pull "
+                        "/debug/flight-recorder from every node and write "
+                        "<out>.flight-<node>.json dumps")
     args = parser.parse_args(argv)
     return asyncio.run(run(args))
 

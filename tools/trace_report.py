@@ -22,7 +22,6 @@ instead of a traceback, and empty stages / span-free traces report
 themselves and exit 0.
 """
 import argparse
-import json
 import os
 import re
 import sys
@@ -31,72 +30,32 @@ from typing import Dict, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from mysticeti_tpu.spans import PIPELINE_STAGES, STAGES  # noqa: E402
-
-
-def _salvage_events(text: str) -> List[dict]:
-    """Recover complete event objects from a truncated trace: find the
-    traceEvents array and raw-decode objects one at a time until the tear."""
-    start = text.find('"traceEvents"')
-    if start < 0:
-        return []
-    start = text.find("[", start)
-    if start < 0:
-        return []
-    decoder = json.JSONDecoder()
-    events: List[dict] = []
-    pos = start + 1
-    n = len(text)
-    while pos < n:
-        while pos < n and text[pos] in " \t\r\n,":
-            pos += 1
-        if pos >= n or text[pos] == "]":
-            break
-        try:
-            event, pos = decoder.raw_decode(text, pos)
-        except ValueError:
-            break  # the tear: everything before it is intact
-        if isinstance(event, dict):
-            events.append(event)
-    return events
+from mysticeti_tpu.spans import (  # noqa: E402
+    PIPELINE_STAGES,
+    STAGES,
+    complete_spans,
+    load_trace_events,
+    stage_chains,
+    track_names,
+)
 
 
 def load_events(path: str) -> Tuple[List[dict], str]:
-    """All events from a Chrome trace-event JSON file (parsed once — a
-    MAX_EVENTS-capped production trace is hundreds of MB).  Returns
-    ``(events, note)``: a truncated/mid-flush tail is tolerated by salvaging
-    the complete events before the tear, reported through ``note``."""
-    with open(path) as f:
-        text = f.read()
-    try:
-        data = json.loads(text)
-    except ValueError:
-        events = _salvage_events(text)
-        return events, (
-            f"note: trace is truncated (mid-flush tail?); salvaged "
-            f"{len(events)} complete event(s)"
-        )
-    if isinstance(data, dict):
-        events = data.get("traceEvents")
-        if not isinstance(events, list):
-            return [], "note: no traceEvents array in trace"
-        return events, ""
-    if isinstance(data, list):
-        return data, ""
-    return [], "note: unrecognized trace shape"
+    """All events from a Chrome trace-event JSON file; salvage + extraction
+    live in ``mysticeti_tpu.spans`` now, SHARED with tools/fleet_trace.py —
+    the two offline consumers must never disagree about where a truncated
+    trace's stage boundaries are."""
+    events, note, _other = load_trace_events(path)
+    return events, note
 
 
 def load_spans(events: List[dict]) -> List[dict]:
     """Complete ("X") span events."""
-    return [e for e in events if e.get("ph") == "X"]
+    return complete_spans(events)
 
 
 def _track_names(events: List[dict]) -> Dict[Tuple[int, int], str]:
-    return {
-        (e.get("pid", 0), e.get("tid", 0)): e["args"]["name"]
-        for e in events
-        if e.get("ph") == "M" and e.get("name") == "thread_name"
-    }
+    return track_names(events)
 
 
 def _pct(ordered: List[float], pct: float) -> float:
@@ -154,21 +113,13 @@ def attribute_critical_paths(spans: List[dict]) -> List[dict]:
     (block label with a ``commit`` span) and observing track, the pipeline
     stage with the largest duration is THE critical-path edge, attributed to
     the leader's authoring authority.  Returns one record per (leader,
-    track)."""
-    # (track, label) -> {stage: dur_s}; only pipeline stages participate.
-    chains: Dict[Tuple[Tuple[int, int], str], Dict[str, float]] = defaultdict(dict)
-    for e in spans:
-        if e["name"] not in PIPELINE_STAGES:
-            continue
-        label = (e.get("args") or {}).get("block")
-        if not label:
-            continue
-        track = (e.get("pid", 0), e.get("tid", 0))
-        dur = e.get("dur", 0) / 1e6
-        prev = chains[(track, label)].get(e["name"])
-        chains[(track, label)][e["name"]] = max(prev or 0.0, dur)
+    track).  Stage extraction is the SHARED ``spans.stage_chains`` helper
+    (also under tools/fleet_trace.py), so a trace tail truncated mid-flush
+    lands on the same stage boundaries in both tools."""
+    chains = stage_chains(spans, stages=PIPELINE_STAGES)
     out: List[dict] = []
-    for (track, label), stages in chains.items():
+    for (track, label), chain in chains.items():
+        stages = {name: dur / 1e6 for name, (_ts, dur) in chain.items()}
         if "commit" not in stages:
             continue  # never committed (or commit fell past the trace cap)
         match = _REF_RE.match(label)
