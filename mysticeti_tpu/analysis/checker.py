@@ -99,6 +99,13 @@ GUARDED_FIELDS: Dict[str, str] = {
     # loop thread while the metrics endpoint / a signal path snapshots it —
     # any reassignment (resize, swap) must happen under the ring lock.
     "_flight_ring": "_ring_lock",
+    # Dissemination frame cache (synchronizer.FrameCache): the encode-once
+    # entry table is read/written per push frame and carries the reuse
+    # census — every mutation outside __init__ must hold the cache lock.
+    # (Named distinctly from network._FrameReceiver._frames, which is
+    # single-threaded by design — GUARDED_FIELDS matches globally by
+    # attribute name.)
+    "_frame_entries": "_frame_lock",
     # Segmented WAL manifest table (storage.py): the segment list is
     # rewritten by the appender on roll/GC/tear-truncation and read by the
     # paired reader, the metrics sampler, and the fsync thread — every

@@ -264,6 +264,33 @@ class Metrics:
             "slo-alert, safety-failure)",
             labels=("trigger",),
         )
+        # Broadcast-once mesh data plane (synchronizer.FrameCache +
+        # network write coalescing): what the encode-once fan-out saved,
+        # what the sockets actually carried, and which sends backpressure
+        # silently discarded.
+        self.dissemination_encode_reuse_total = counter(
+            "dissemination_encode_reuse_total",
+            "dissemination frames served from the shared frame cache "
+            "instead of being rebuilt per subscriber (N subscribers at one "
+            "cursor = 1 build + N-1 reuses)",
+        )
+        self.mesh_frames_coalesced_total = counter(
+            "mesh_frames_coalesced_total",
+            "mesh frames that shipped in the same scatter-gather "
+            "writelines batch as an earlier frame (one syscall + one "
+            "drain for the whole batch)",
+        )
+        self.mesh_wire_bytes_total = counter(
+            "mesh_wire_bytes_total",
+            "bytes moved over validator mesh sockets (headers + payloads)",
+            labels=("direction",),
+        )
+        self.connection_send_drops_total = counter(
+            "connection_send_drops_total",
+            "non-blocking mesh sends discarded because the peer's bounded "
+            "send queue was full (backpressure; previously silent)",
+            labels=("peer",),
+        )
 
         # TPU verifier.
         self.verified_signatures_total = counter(

@@ -46,7 +46,13 @@ from .tracing import logger
 from .utils.tasks import spawn_logged
 
 log = logger(__name__)
-from .synchronizer import BlockDisseminator, BlockFetcher, HelperSubscriptions
+from .network import mesh_legacy
+from .synchronizer import (
+    BlockDisseminator,
+    BlockFetcher,
+    FrameCache,
+    HelperSubscriptions,
+)
 from .types import AuthoritySet, StatementBlock, VerificationError
 
 CLEANUP_INTERVAL_S = 10.0
@@ -66,17 +72,24 @@ class Notify:
     condition can never miss a notification that follows the check — unlike
     the set-then-``call_soon``-clear Event pattern, where a task awaiting
     between set and clear lost the edge.
+
+    ``generation`` counts notifications: the dissemination FrameCache keys
+    entries on it, so a frame built before a new block landed can never be
+    served after (the key simply stops matching) — cheap whole-cache
+    invalidation without a registry of entries.
     """
 
-    __slots__ = ("_event",)
+    __slots__ = ("_event", "generation")
 
     def __init__(self) -> None:
         self._event = asyncio.Event()
+        self.generation = 0
 
     def subscribe(self) -> asyncio.Event:
         return self._event
 
     def notify(self) -> None:
+        self.generation += 1
         event, self._event = self._event, asyncio.Event()
         event.set()
 
@@ -140,6 +153,11 @@ class NetworkSyncer:
         )
         self._tasks: List[asyncio.Task] = []
         self._disseminators: Dict[int, BlockDisseminator] = {}
+        # Encode-once fan-out (synchronizer.FrameCache): one shared cache
+        # across every peer's disseminator, so N-1 subscribers at the same
+        # cursor ship one serialization.  MYSTICETI_MESH_LEGACY=1 restores
+        # the per-peer build path (the A/B baseline).
+        self.frame_cache = None if mesh_legacy() else FrameCache(metrics)
         # Helper-stream bookkeeping (requester side; armed by the
         # disseminate_others_blocks knob): which connected peers relay which
         # unreachable authority's blocks for us, within the config caps.
@@ -255,6 +273,7 @@ class NetworkSyncer:
             self.signals.block_ready,
             self.parameters.synchronizer,
             self.metrics,
+            frame_cache=self.frame_cache,
         )
         self._disseminators[peer] = disseminator
         # Ask the peer for its own blocks we have not yet seen.
@@ -551,6 +570,13 @@ class NetworkSyncer:
                     inflight.discard(ref)
 
     # -- the receive pipeline (net_sync.rs:314-386), three stages --
+    #
+    # Ingest batching invariant (audited for the broadcast-once plane, and
+    # pinned by the whole-frame census test): a frame of K blocks crosses
+    # the core owner exactly TWICE — one `processed()` dedup command for
+    # the whole batch and one `add_blocks()` for the accepted batch.
+    # Nothing in this pipeline may hop to the owner per block; a regression
+    # here multiplies the owner queue by the frame size at saturation.
 
     async def _decode_fresh(
         self, serialized_blocks, transit=None

@@ -16,12 +16,39 @@ active+passive connections per peer; here the lower authority index dials and
 the higher accepts — same full-mesh + reconnect capability with half the
 connection-management states.  ``Connection`` is a pair of asyncio queues, so
 the simulated network (simulated_network.py) is a drop-in replacement.
+
+Broadcast-once data plane (endpoint-local; on-wire bytes unchanged):
+
+* **encode-once fan-out** — dissemination streams enqueue
+  :class:`EncodedFrame` objects from the shared
+  :class:`~mysticeti_tpu.synchronizer.FrameCache`, so N-1 subscribers at the
+  same cursor ship one serialization instead of re-encoding per peer;
+* **scatter-gather write coalescing** — ``write_loop`` drains every queued
+  message non-blocking and ships the batch as one
+  ``writer.writelines([hdr, payload, ...])`` + a single ``drain()`` (headers
+  are fresh immutable objects per write: a 3.12+ transport may hold frame N
+  zero-copy in its buffer while we build frame N+1).  Ping/Pong jump the
+  batch — RTT probes never queue behind bulk payloads;
+* **zero-copy receive** — after the handshake the transport is switched onto
+  :class:`_FrameReceiver` (``asyncio.BufferedProtocol``): the event loop
+  ``recv_into``s directly into a reusable per-connection assembly buffer,
+  frames surface as memoryviews, ``decode_message`` makes block payloads
+  sub-views, and ``StatementBlock.from_bytes`` materializes exactly one
+  ``bytes`` per block for the canonical cache.
+
+``MYSTICETI_MESH_LEGACY=1`` forces the pre-r10 path (per-peer encode,
+per-frame write+drain, StreamReader receive) — the A/B baseline for
+``tools/mesh_ab.py`` and a safety valve; both endpoints interoperate either
+way because the frames are byte-identical.
 """
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
+import os
 import random
+import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -35,6 +62,19 @@ log = logger(__name__)
 HANDSHAKE_MAGIC = 0x7C9A_11B7
 MAX_FRAME = 16 * 1024 * 1024
 PING_INTERVAL_S = 30.0
+# Byte cap on one coalesced writelines batch: enough to amortize the
+# syscall/drain over many small frames, small enough that a deep queue of
+# multi-MB frames still hits transport flow control per batch instead of
+# buffering the whole queue.
+MAX_COALESCE_BYTES = 1 << 20
+
+
+def mesh_legacy() -> bool:
+    """True when ``MYSTICETI_MESH_LEGACY=1``: run the pre-broadcast-once
+    data plane (per-peer encode, per-frame write, stream receive).  Read
+    per connection setup, not cached — tests and the A/B harness flip it
+    between runs in one process."""
+    return os.environ.get("MYSTICETI_MESH_LEGACY", "") == "1"
 
 def jittered_backoff(delay: float, rng: random.Random) -> float:
     """Uniform [0.5, 1.5)x jitter around an exponential-backoff delay.
@@ -219,7 +259,15 @@ def encode_message(msg: NetworkMessage) -> bytes:
     return w.finish()
 
 
-def decode_message(data: bytes) -> NetworkMessage:
+def decode_message(data) -> NetworkMessage:
+    """Decode one frame payload (``bytes`` or ``memoryview``).
+
+    With a memoryview input — the zero-copy receive path — the block
+    payloads inside ``Blocks``/``RequestBlocksResponse`` come back as
+    sub-views over the caller's buffer; ``StatementBlock.from_bytes``
+    materializes each exactly once for the canonical cache.  Everything
+    else (references, digests, the snapshot manifest) is materialized here.
+    """
     r = Reader(data)
     tag = r.u8()
     if tag == _MSG_SUBSCRIBE:
@@ -241,7 +289,9 @@ def decode_message(data: bytes) -> NetworkMessage:
     elif tag == _MSG_REQUEST_SNAPSHOT:
         msg = RequestSnapshot(r.u64())
     elif tag == _MSG_SNAPSHOT:
-        msg = SnapshotResponse(r.bytes())
+        # Manifests are materialized at decode (never a view): the adopted
+        # one is persisted to the WAL and must outlive the receive buffer.
+        msg = SnapshotResponse(bytes(r.bytes()))
     elif tag == _MSG_REQUEST_SNAPSHOT_STREAM:
         msg = RequestSnapshotStream(r.u64())
     elif tag == _MSG_BLOCKS_TIMESTAMPED:
@@ -257,6 +307,81 @@ def decode_message(data: bytes) -> NetworkMessage:
     return msg
 
 
+class EncodedFrame:
+    """A message plus its cached frame payload (encode-once fan-out).
+
+    The shared :class:`~mysticeti_tpu.synchronizer.FrameCache` hands the
+    SAME EncodedFrame object to every subscriber at one cursor; the TCP
+    ``write_loop`` ships ``payload`` without re-encoding, while the
+    simulated network delivers ``message`` object-identically and never
+    pays for serialization at all (``payload`` is built lazily on first
+    wire access).  ``payload`` is byte-identical to
+    ``encode_message(message)`` — pinned by the golden-corpus test."""
+
+    __slots__ = ("message", "_payload")
+
+    def __init__(self, message: NetworkMessage, payload: Optional[bytes] = None) -> None:
+        self.message = message
+        self._payload = payload
+
+    @property
+    def payload(self) -> bytes:
+        if self._payload is None:
+            self._payload = encode_message(self.message)
+        return self._payload
+
+
+def frame_payload(msg: NetworkMessage) -> bytes:
+    """The wire payload for a queued message: the cached bytes of an
+    :class:`EncodedFrame`, a fresh encode for everything else."""
+    if type(msg) is EncodedFrame:
+        return msg.payload
+    return encode_message(msg)
+
+
+class _SendQueue(asyncio.Queue):
+    """Bounded send queue with a capped urgent lane.
+
+    ``put_front_nowait`` enqueues ahead of everything already queued and
+    ignores the bulk bound — reserved for Ping/Pong, so an RTT probe can
+    never sit behind a saturated bulk backlog inflating the latency
+    estimate into the 5 s breaker (the snapshot-stream false-trip).  The
+    lane has its OWN small cap: the echo path answers every received Ping
+    with a Pong, and without a bound a peer flooding Pings while refusing
+    to read would grow the deque without limit (the old per-message path
+    backpressured via the full queue).  Legitimate traffic is one probe
+    per ``PING_INTERVAL_S`` plus its echo — nowhere near the cap; over it,
+    the probe is dropped, which the protocol tolerates by design.
+    Mirrors ``put_nowait`` on the documented-stable asyncio.Queue
+    internals (``_queue`` deque + getter wakeup)."""
+
+    URGENT_CAP = 16
+
+    def _init(self, maxsize: int) -> None:
+        super()._init(maxsize)
+        self.urgent_queued = 0
+
+    def _get(self):
+        item = self._queue.popleft()
+        if type(item) is Ping or type(item) is Pong:
+            self.urgent_queued -= 1
+        return item
+
+    def put_front_nowait(self, item) -> bool:
+        if self.urgent_queued >= self.URGENT_CAP:
+            return False
+        self.urgent_queued += 1
+        self._queue.appendleft(item)
+        self._unfinished_tasks += 1
+        self._finished.clear()
+        self._wakeup_next(self._getters)
+        return True
+
+
+def _is_urgent(msg: NetworkMessage) -> bool:
+    return type(msg) is Ping or type(msg) is Pong
+
+
 class Connection:
     """One live peer link: outgoing via ``send``, incoming via ``receiver``.
 
@@ -266,26 +391,51 @@ class Connection:
     Worker semantics).
     """
 
-    def __init__(self, peer: int, latency_getter=None) -> None:
+    def __init__(self, peer: int, latency_getter=None, metrics=None) -> None:
         self.peer = peer
-        self.sender: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self.sender: asyncio.Queue = _SendQueue(maxsize=1024)
         self.receiver: asyncio.Queue = asyncio.Queue(maxsize=1024)
         self._closed = asyncio.Event()
         self._latency_getter = latency_getter
+        self.metrics = metrics
 
     def try_send(self, msg: NetworkMessage) -> bool:
         """Non-blocking send; drops (returns False) when the peer is slow —
-        the reference's bounded-channel backpressure behavior."""
+        the reference's bounded-channel backpressure behavior.  Drops are
+        counted on ``connection_send_drops_total{peer}`` (they were silent:
+        a fleet losing fetch requests to backpressure looked identical to
+        one that never sent them)."""
         if self.is_closed():
+            return False
+        if _is_urgent(msg):
+            if self.sender.put_front_nowait(msg):
+                return True
+            self._count_drop()
             return False
         try:
             self.sender.put_nowait(msg)
             return True
         except asyncio.QueueFull:
+            self._count_drop()
             return False
+
+    def _count_drop(self) -> None:
+        if self.metrics is not None:
+            self.metrics.connection_send_drops_total.labels(
+                str(self.peer)
+            ).inc()
 
     async def send(self, msg: NetworkMessage) -> None:
         if self.is_closed():
+            return
+        if _is_urgent(msg):
+            # Ping/Pong jump the queue AND never block behind a full one —
+            # a saturated bulk stream must not delay (or deadlock) the RTT
+            # probe that decides whether this link is healthy.  Beyond the
+            # urgent-lane cap (a ping flood) the probe is dropped, never
+            # queued unboundedly.
+            if not self.sender.put_front_nowait(msg):
+                self._count_drop()
             return
         await self.sender.put(msg)
 
@@ -306,12 +456,21 @@ class Connection:
         for p in pending:
             p.cancel()
         if get in done:
-            return get.result()
+            return self._unwrap(get.result())
         # Drain anything already delivered before reporting closure.
         try:
-            return self.receiver.get_nowait()
+            return self._unwrap(self.receiver.get_nowait())
         except asyncio.QueueEmpty:
             return None
+
+    @staticmethod
+    def _unwrap(msg):
+        """Simulated links deliver the disseminator's EncodedFrame objects
+        verbatim (no serialization in-process); consumers see the message,
+        keeping the sim a drop-in for the TCP transport."""
+        if type(msg) is EncodedFrame:
+            return msg.message
+        return msg
 
     def latency(self) -> float:
         """Smoothed RTT estimate in seconds (inf until first pong)."""
@@ -336,6 +495,249 @@ async def _read_frame(reader: asyncio.StreamReader) -> bytes:
 
 def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     writer.write(len(payload).to_bytes(4, "little") + payload)
+
+
+class _FrameReceiver(asyncio.BufferedProtocol):
+    """Zero-copy mesh frame receiver: ``recv_into`` a reusable buffer.
+
+    After the stream handshake the connection's transport is switched onto
+    this protocol (``transport.set_protocol`` retargets the selector's
+    read-ready path to ``get_buffer``/``buffer_updated``): the event loop
+    then ``recv_into``s DIRECTLY into the per-connection assembly buffer —
+    no StreamReader ``feed_data`` append copy, no ``readexactly`` join
+    copy.  ``read_frame`` yields complete frames as memoryviews over the
+    buffer; ``decode_message`` turns block payloads into sub-views and
+    ``StatementBlock.from_bytes`` materializes exactly one ``bytes`` per
+    block for the canonical cache, so a disseminated block's bytes are
+    copied once between the kernel and the DAG.
+
+    Buffer lifecycle: the assembly buffer is reused across frames.  When
+    compaction or growth would disturb a frame view still alive downstream
+    (deep receive pipelining holds decoded-but-unconsumed frames), the
+    unparsed tail moves to a FRESH buffer and the old one is left to the
+    GC with its views — detected by refcount: the buffer has exactly two
+    references (the attribute + the check's argument) when no view is
+    exported.  Views never outlive their backing store.
+
+    Division of labor with the streams machinery: the WRITE half stays on
+    the original ``StreamWriter``/``StreamReaderProtocol`` — pause/resume
+    and connection_lost are forwarded so ``writer.drain()`` keeps its flow
+    -control contract.  READ-side backpressure is ours: parsed-but-unread
+    frames beyond ``MAX_BUFFERED_FRAMES`` pause the transport until
+    ``read_frame`` drains them (the old path got the same effect from the
+    StreamReader high-water mark).
+    """
+
+    MIN_BUF = 64 * 1024
+    MAX_BUFFERED_FRAMES = 64
+
+    def __init__(self, stream_protocol, transport) -> None:
+        self._stream_protocol = stream_protocol
+        self._transport = transport
+        self._buf = bytearray(self.MIN_BUF)
+        self._start = 0  # offset of the first unparsed byte
+        self._have = 0  # offset one past the last filled byte
+        self._frames: collections.deque = collections.deque()
+        self._waiter: Optional[asyncio.Future] = None
+        self._exc: Optional[BaseException] = None
+        self._eof = False
+        self._paused = False
+        # True between get_buffer and the matching buffer_updated: the
+        # event loop holds a view of _buf for an in-flight recv.  On the
+        # selector loop the pair is synchronous, but a proactor loop keeps
+        # the view across the overlapped recv — swapping _buf then would
+        # send incoming bytes into the orphaned buffer.
+        self._recv_pending = False
+
+    @classmethod
+    def attach(cls, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        """Switch a handshaken stream connection to zero-copy reads.
+
+        Returns None when the transport cannot be switched (mock streams in
+        tests, ``MYSTICETI_MESH_LEGACY=1``) — the caller falls back to the
+        ``_read_frame(reader)`` stream path, frame-for-frame compatible."""
+        if mesh_legacy():
+            return None
+        transport = getattr(writer, "transport", None)
+        buffered = getattr(reader, "_buffer", None)
+        if (
+            transport is None
+            or not isinstance(buffered, bytearray)
+            or not hasattr(transport, "set_protocol")
+            or not hasattr(transport, "get_protocol")
+        ):
+            return None
+        try:
+            receiver = cls(transport.get_protocol(), transport)
+            # Switch FIRST: if the transport refuses (base-class stub, a
+            # wrapper), the StreamReader's buffer is untouched and the
+            # stream fallback stays whole.  The switch and the drain below
+            # run in one synchronous step, so no data callback can land
+            # between them.
+            transport.set_protocol(receiver)
+        except (AttributeError, NotImplementedError):
+            return None
+        # Bytes the stream consumed off the socket between the handshake
+        # and the switch belong to us now — seed the assembly buffer so
+        # nothing is lost or read twice.
+        if buffered:
+            receiver._reserve(len(buffered))
+            receiver._buf[: len(buffered)] = buffered
+            receiver._have = len(buffered)
+            del buffered[:]
+            receiver._parse()
+        if not receiver._paused:
+            # The StreamReader may have paused the transport itself (a
+            # handshake-window burst past 2x its limit); its pause is not
+            # ours and nothing else would ever resume it — the read side
+            # would stall forever while pings keep flowing out.
+            try:
+                transport.resume_reading()
+            except Exception:  # noqa: BLE001 - not paused / closing: fine
+                pass
+        return receiver
+
+    # -- consumer side --
+
+    async def read_frame(self) -> memoryview:
+        """Next complete frame payload (header stripped) as a memoryview.
+
+        Raises ``IncompleteReadError`` on EOF and the stored exception on
+        transport error — the same failure surface ``_read_frame`` has."""
+        while not self._frames:
+            if self._exc is not None:
+                raise self._exc
+            if self._eof:
+                raise asyncio.IncompleteReadError(b"", 4)
+            self._waiter = asyncio.get_event_loop().create_future()
+            try:
+                await self._waiter
+            finally:
+                self._waiter = None
+        frame = self._frames.popleft()
+        if (
+            not self._frames
+            and self._start == self._have
+            and len(self._buf) > 8 * self.MIN_BUF
+            and not self._recv_pending
+        ):
+            # A past jumbo frame grew the assembly buffer; once the backlog
+            # fully clears, swap in a fresh small one — a 50-peer node
+            # would otherwise pin one jumbo buffer per connection forever.
+            # Always safe: live downstream views (including the frame just
+            # popped) keep the OLD buffer alive; we only stop writing to it.
+            self._buf = bytearray(self.MIN_BUF)
+            self._start = self._have = 0
+        if self._paused and len(self._frames) <= self.MAX_BUFFERED_FRAMES // 2:
+            self._paused = False
+            try:
+                self._transport.resume_reading()
+            except Exception:  # noqa: BLE001 - transport already gone
+                pass
+        return frame
+
+    # -- BufferedProtocol callbacks (event-loop thread) --
+
+    def get_buffer(self, sizehint: int) -> memoryview:
+        tail = self._have - self._start
+        need = 4096
+        if tail >= 4:
+            # A partial frame is pending: reserve enough for its remainder
+            # so large frames assemble without quadratic regrowth.  An
+            # over-MAX length is not our problem here — _parse rejects it.
+            length = int.from_bytes(
+                self._buf[self._start : self._start + 4], "little"
+            )
+            if length <= MAX_FRAME:
+                need = max(need, 4 + length - tail)
+        if len(self._buf) - self._have < need:
+            self._reserve(need)
+        self._recv_pending = True
+        return memoryview(self._buf)[self._have :]
+
+    def buffer_updated(self, nbytes: int) -> None:
+        self._recv_pending = False
+        self._have += nbytes
+        self._parse()
+
+    def eof_received(self) -> bool:
+        self._eof = True
+        self._wake()
+        return False  # a half-closed mesh peer is a dead peer: close
+
+    def connection_lost(self, exc: Optional[BaseException]) -> None:
+        self._recv_pending = False
+        if exc is not None:
+            self._exc = exc
+        self._eof = True
+        self._wake()
+        # The write half (StreamWriter.drain / wait_closed) still lives on
+        # the original protocol: it must observe the loss.
+        self._stream_protocol.connection_lost(exc)
+
+    def pause_writing(self) -> None:
+        self._stream_protocol.pause_writing()
+
+    def resume_writing(self) -> None:
+        self._stream_protocol.resume_writing()
+
+    # -- internals --
+
+    def _wake(self) -> None:
+        if self._waiter is not None and not self._waiter.done():
+            self._waiter.set_result(None)
+
+    def _views_exported(self) -> bool:
+        # Two references = self._buf + getrefcount's argument; anything
+        # beyond that is a parsed frame view (queued here or held by a
+        # consumer downstream).
+        return sys.getrefcount(self._buf) > 2 or bool(self._frames)
+
+    def _reserve(self, need: int) -> None:
+        """Ensure ``need`` writable bytes after ``_have``, compacting the
+        unparsed tail to offset 0 (into a fresh buffer if live views pin
+        the current one)."""
+        tail = self._have - self._start
+        cap = len(self._buf)
+        want = tail + need
+        if want > cap:
+            cap = max(self.MIN_BUF, 1 << (want - 1).bit_length())
+        if cap != len(self._buf) or self._views_exported():
+            new = bytearray(cap)
+            new[:tail] = memoryview(self._buf)[self._start : self._have]
+            self._buf = new
+        elif self._start:
+            self._buf[:tail] = self._buf[self._start : self._have]
+        self._start, self._have = 0, tail
+
+    def _parse(self) -> None:
+        buf, start, have = self._buf, self._start, self._have
+        while have - start >= 4:
+            length = int.from_bytes(buf[start : start + 4], "little")
+            if length > MAX_FRAME:
+                self._exc = SerdeError(
+                    f"frame of {length} bytes exceeds MAX_FRAME"
+                )
+                self._wake()
+                self._transport.close()
+                return
+            end = start + 4 + length
+            if end > have:
+                break
+            self._frames.append(memoryview(buf)[start + 4 : end])
+            start = end
+        self._start = start
+        if self._frames:
+            self._wake()
+            if (
+                len(self._frames) > self.MAX_BUFFERED_FRAMES
+                and not self._paused
+            ):
+                self._paused = True
+                try:
+                    self._transport.pause_reading()
+                except Exception:  # noqa: BLE001 - transport already gone
+                    pass
 
 
 class TcpNetwork:
@@ -435,15 +837,35 @@ class TcpNetwork:
     # -- shared read/write/ping loops --
 
     async def _run_peer(self, peer: int, reader, writer) -> None:
-        conn = Connection(peer, latency_getter=lambda p=peer: self._latency.get(p, float("inf")))
+        conn = Connection(
+            peer,
+            latency_getter=lambda p=peer: self._latency.get(p, float("inf")),
+            metrics=self.metrics,
+        )
         await self.connections.put(conn)
+        legacy = mesh_legacy()
+        receiver = None if legacy else _FrameReceiver.attach(reader, writer)
+        metrics = self.metrics
+        recv_bytes = sent_bytes = coalesced = None
+        if metrics is not None and not legacy:
+            recv_bytes = metrics.mesh_wire_bytes_total.labels("received")
+            sent_bytes = metrics.mesh_wire_bytes_total.labels("sent")
+            coalesced = metrics.mesh_frames_coalesced_total
 
         async def read_loop():
             while True:
-                frame = await _read_frame(reader)
+                if receiver is not None:
+                    frame = await receiver.read_frame()
+                else:
+                    frame = await _read_frame(reader)
+                if recv_bytes is not None:
+                    recv_bytes.inc(len(frame) + 4)
                 msg = decode_message(frame)
                 if isinstance(msg, Ping):
-                    await conn.sender.put(Pong(msg.nanos))
+                    # Priority lane: the echo must not queue behind bulk
+                    # frames or the peer's RTT estimate absorbs our send
+                    # backlog (Connection.send front-queues Ping/Pong).
+                    await conn.send(Pong(msg.nanos))
                     continue
                 if isinstance(msg, Pong):
                     rtt = (time.monotonic_ns() - msg.nanos) / 1e9
@@ -461,14 +883,66 @@ class TcpNetwork:
                 await conn.receiver.put(msg)
 
         async def write_loop():
+            import contextlib
+
+            encode_timer = (
+                metrics.utilization_timer
+                if metrics is not None
+                else (lambda _name: contextlib.nullcontext())
+            )
+            if legacy:
+                # Pre-r10 path: one encode + one concat + one drain PER
+                # frame.  The encode timer runs here too so the A/B
+                # artifact can compare mesh encode CPU across modes.
+                while True:
+                    msg = await conn.sender.get()
+                    with encode_timer("net:mesh_encode"):
+                        payload = frame_payload(msg)
+                    _write_frame(writer, payload)
+                    await writer.drain()
             while True:
+                # Scatter-gather coalescing: drain the queue non-blocking
+                # and ship the batch as one writelines + ONE drain — the
+                # per-frame header+payload concat and per-frame drain were
+                # a measurable share of mesh send CPU at load.  The batch
+                # is byte-capped: the old per-frame drain throttled the
+                # transport buffer one frame at a time, and an unbounded
+                # drain of a deep queue of multi-MB frames would buffer
+                # them ALL before the flow-control await.
                 msg = await conn.sender.get()
-                _write_frame(writer, encode_message(msg))
+                urgent_parts: List[bytes] = []
+                parts: List[bytes] = []
+                total = 0
+                count = 0
+                with encode_timer("net:mesh_encode"):
+                    while True:
+                        payload = frame_payload(msg)
+                        # Ping/Pong lead the writelines batch (never behind
+                        # bulk payloads); headers are fresh immutable
+                        # objects per write (the PR 5 transport-buffer
+                        # lesson: a 3.12+ transport may hold frame N
+                        # zero-copy in its buffer while N+1 is built).
+                        dest = urgent_parts if _is_urgent(msg) else parts
+                        dest.append(len(payload).to_bytes(4, "little"))
+                        dest.append(payload)
+                        total += 4 + len(payload)
+                        count += 1
+                        if total >= MAX_COALESCE_BYTES:
+                            break
+                        try:
+                            msg = conn.sender.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                writer.writelines(urgent_parts + parts)
+                if sent_bytes is not None:
+                    sent_bytes.inc(total)
+                if coalesced is not None and count > 1:
+                    coalesced.inc(count - 1)
                 await writer.drain()
 
         async def ping_loop():
             while True:
-                await conn.sender.put(Ping(time.monotonic_ns()))
+                await conn.send(Ping(time.monotonic_ns()))
                 await asyncio.sleep(PING_INTERVAL_S)
 
         tasks = [

@@ -56,15 +56,24 @@ class Writer:
 
 
 class Reader:
-    """Sequential canonical decoder with bounds checking."""
+    """Sequential canonical decoder with bounds checking.
+
+    ``data`` may be ``bytes`` or a ``memoryview``.  With a memoryview input
+    the variable-length :meth:`bytes` fields come back as sub-views over the
+    caller's buffer — the zero-copy receive mode the mesh read path uses for
+    block payloads (``network.decode_message``); the caller owns the buffer
+    lifetime and must materialize (``bytes(view)``) anything that outlives
+    it.  Fixed-width fields (:meth:`fixed`) always materialize: digests and
+    signatures are used as dict keys and must stay hashable.
+    """
 
     __slots__ = ("data", "pos")
 
-    def __init__(self, data: bytes, pos: int = 0) -> None:
+    def __init__(self, data, pos: int = 0) -> None:
         self.data = data
         self.pos = pos
 
-    def _take(self, n: int) -> bytes:
+    def _take(self, n: int):
         end = self.pos + n
         if end > len(self.data):
             raise SerdeError(
@@ -86,9 +95,11 @@ class Reader:
     def fixed(self, n: int) -> bytes:
         return bytes(self._take(n))
 
-    def bytes(self) -> bytes:
+    def bytes(self):
+        """Length-prefixed field: a fresh ``bytes`` for bytes input, a
+        zero-copy sub-view for memoryview input (see class docstring)."""
         n = self.u32()
-        return bytes(self._take(n))
+        return self._take(n)
 
     def done(self) -> bool:
         return self.pos == len(self.data)

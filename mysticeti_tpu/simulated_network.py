@@ -9,6 +9,13 @@ Drop-in for :class:`mysticeti_tpu.network.TcpNetwork`: exposes the same
 ``connections`` queue of :class:`Connection` objects.  Message delivery is a
 ``loop.call_later`` on the DeterministicLoop, so ordering is reproducible by
 seed.
+
+Broadcast-once parity: dissemination streams enqueue
+:class:`~mysticeti_tpu.network.EncodedFrame` wrappers (encode-once
+fan-out).  The pumps move them verbatim — the payload property is lazy, so
+a simulation never pays for serialization — and ``Connection.recv`` unwraps
+to the message on the receiving side; fault injectors see one object per
+message exactly as before, keeping same-seed fault logs byte-identical.
 """
 from __future__ import annotations
 

@@ -12,7 +12,9 @@ Capability parity with ``mysticeti-core/src/synchronizer.rs``:
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, List, Optional, Sequence
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .block_store import BlockStore
 from .config import SynchronizerParameters
@@ -23,6 +25,7 @@ from .network import (
     BlockNotFound,
     Blocks,
     Connection,
+    EncodedFrame,
     RequestBlocks,
     RequestBlocksResponse,
     TimestampedBlocks,
@@ -36,6 +39,86 @@ MAXIMUM_BLOCK_REQUEST = 50  # net_sync.rs:30
 DISSEMINATION_CHUNK = 10  # synchronizer.rs:74 send_blocks chunking
 
 
+class FrameCache:
+    """Encode-once fan-out: one built push frame per (stream, cursor).
+
+    Every ``BlockDisseminator`` of a node shares one FrameCache.  A push
+    stream about to send from cursor ``c`` first asks the cache: if another
+    subscriber already built the frame for the same stream at the same
+    cursor (and no new block has landed since — entries are keyed by the
+    ``block_ready`` notify GENERATION, so any store change invalidates by
+    key), it ships the identical immutable :class:`EncodedFrame` object —
+    N-1 subscribers at one cursor cost 1 store read + 1 serialization
+    instead of N.  Per-peer cursors are untouched: the cache only
+    deduplicates the (store read, message build, wire encode) work, never
+    the stream positions.
+
+    Entries are LRU-bounded (``CAPACITY``): a fleet's subscribers cluster
+    at the live frontier, so the working set is a handful of cursors; a
+    straggler at an old cursor simply rebuilds (a miss is the pre-cache
+    behavior, never an error).  ``dissemination_encode_reuse_total`` counts
+    the saved builds; the census test pins N subscribers → 1 build +
+    N-1 reuses.
+
+    Thread discipline: all access is on the event loop today, but the
+    entry table follows the repo's lock rule anyway (`_frame_entries` mutations
+    under ``_frame_lock`` — enforced by the static lint's GUARDED_FIELDS).
+    """
+
+    CAPACITY = 64
+    # Reuse window for STAMPED frames (timestamp_frames on): a cached
+    # TimestampedBlocks carries its build-time sender clocks, and on a
+    # quiet network the generation key never advances — without an age
+    # bound, a late (re)subscriber at an old cursor would receive a frame
+    # stamped arbitrarily earlier and the receiver would record the cache
+    # AGE as wire transit, poisoning dissemination_transit_seconds and the
+    # fleet-trace skew estimator.  Same-wake subscribers share well inside
+    # this window; anything older rebuilds with fresh stamps.  Clocked by
+    # the runtime clock, so seeded sims stay deterministic.
+    STAMPED_REUSE_WINDOW_S = 0.025
+
+    def __init__(self, metrics=None) -> None:
+        self.metrics = metrics
+        self._frame_lock = threading.Lock()
+        self._frame_entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # Census counters (tests + the A/B artifact read these directly;
+        # the prometheus series mirrors reuses).
+        self.builds = 0
+        self.reuses = 0
+
+    def get(self, key: tuple, max_age_s: Optional[float] = None) -> Optional[tuple]:
+        """The cached ``(frame, to_cursor, block_count)`` for ``key``, or
+        None; a hit counts one saved encode.  ``max_age_s`` expires entries
+        older than the window (stamped frames) — an expired entry is
+        dropped and the caller rebuilds."""
+        with self._frame_lock:
+            cached = self._frame_entries.get(key)
+            if cached is None:
+                return None
+            entry, built_at = cached
+            if max_age_s is not None:
+                from .runtime import now as runtime_now
+
+                if runtime_now() - built_at > max_age_s:
+                    del self._frame_entries[key]
+                    return None
+            self._frame_entries.move_to_end(key)
+            self.reuses += 1
+        if self.metrics is not None:
+            self.metrics.dissemination_encode_reuse_total.inc()
+        return entry
+
+    def put(self, key: tuple, entry: tuple) -> None:
+        from .runtime import now as runtime_now
+
+        with self._frame_lock:
+            self.builds += 1
+            self._frame_entries[key] = (entry, runtime_now())
+            self._frame_entries.move_to_end(key)
+            while len(self._frame_entries) > self.CAPACITY:
+                self._frame_entries.popitem(last=False)
+
+
 class BlockDisseminator:
     """Serves one peer connection (synchronizer.rs:25-164)."""
 
@@ -46,12 +129,17 @@ class BlockDisseminator:
         block_ready,  # Notify (net_sync.py): lost-wakeup-free level trigger
         parameters: Optional[SynchronizerParameters] = None,
         metrics=None,
+        frame_cache: Optional[FrameCache] = None,
     ) -> None:
         self.connection = connection
         self.block_store = block_store
         self.block_ready = block_ready
         self.parameters = parameters or SynchronizerParameters()
         self.metrics = metrics
+        # Encode-once fan-out: shared across the node's disseminators by
+        # NetworkSyncer; None (direct construction, MYSTICETI_MESH_LEGACY)
+        # keeps the per-peer build path.
+        self.frame_cache = frame_cache
         self._stream_task: Optional[asyncio.Task] = None
         # Helper streams (synchronizer.rs:169-205, dormant in the reference;
         # live here behind SynchronizerParameters.disseminate_others_blocks):
@@ -113,6 +201,58 @@ class BlockDisseminator:
             self._stream_others(authority, from_round), log
         )
 
+    def _push_frame(
+        self, kind: str, authority: Optional[int], cursor: RoundNumber
+    ) -> Tuple[Optional[EncodedFrame], RoundNumber, int]:
+        """One dissemination push frame from ``cursor``: ``(frame,
+        new_cursor, block_count)``, with ``frame=None`` when the store has
+        nothing past the cursor.
+
+        Encode-once fan-out: when the shared :class:`FrameCache` is wired,
+        subscribers at the same (stream, cursor, notify generation) receive
+        the IDENTICAL immutable frame object — the store read, the message
+        build, and (on the TCP transport) the wire serialization happen
+        once per frame instead of once per peer.  The notify generation in
+        the key self-invalidates on every new block, so a cached frame can
+        never mask store changes; per-peer cursors advance exactly as the
+        uncached path would."""
+        cache = self.frame_cache
+        gen = getattr(self.block_ready, "generation", None)
+        key = None
+        if cache is not None and gen is not None:
+            key = (
+                kind, authority, cursor, self.parameters.batch_size,
+                self.parameters.timestamp_frames, gen,
+            )
+            hit = cache.get(
+                key,
+                max_age_s=(
+                    cache.STAMPED_REUSE_WINDOW_S
+                    if self.parameters.timestamp_frames
+                    else None
+                ),
+            )
+            if hit is not None:
+                return hit
+        if kind == "own":
+            blocks = self.block_store.get_own_blocks(
+                cursor, self.parameters.batch_size
+            )
+        else:
+            blocks = self.block_store.get_others_blocks(
+                cursor, authority, self.parameters.batch_size
+            )
+        if not blocks:
+            return None, cursor, 0
+        to_cursor = max(b.round() for b in blocks)
+        frame = EncodedFrame(
+            self._blocks_message(tuple(b.to_bytes() for b in blocks))
+        )
+        entry = (frame, to_cursor, len(blocks))
+        if key is not None:
+            cache.put(key, entry)
+        return entry
+
     async def _stream_others(
         self, authority: int, from_round: RoundNumber
     ) -> None:
@@ -120,18 +260,12 @@ class BlockDisseminator:
         the store's others-blocks cursor — the peer verifies and re-hashes
         every relayed block (wire-format §5), so a relay cannot forge."""
         cursor = from_round
-        batch_size = self.parameters.batch_size
         while not self.connection.is_closed():
             waiter = self.block_ready.subscribe()
-            blocks = self.block_store.get_others_blocks(
-                cursor, authority, batch_size
-            )
-            if blocks:
-                cursor = max(b.round() for b in blocks)
-                self.helper_blocks_sent += len(blocks)
-                await self.connection.send(
-                    self._blocks_message(tuple(b.to_bytes() for b in blocks))
-                )
+            frame, cursor, count = self._push_frame("others", authority, cursor)
+            if frame is not None:
+                self.helper_blocks_sent += count
+                await self.connection.send(frame)
             else:
                 try:
                     await asyncio.wait_for(
@@ -143,17 +277,13 @@ class BlockDisseminator:
     async def _stream_own(self, from_round: RoundNumber) -> None:
         """Push loop (synchronizer.rs:131-164): batch, send, wait for new blocks."""
         cursor = from_round
-        batch_size = self.parameters.batch_size
         while not self.connection.is_closed():
             # Subscribe BEFORE reading the store: a block landing between the
             # read and the wait then still wakes us (no lost edge).
             waiter = self.block_ready.subscribe()
-            blocks = self.block_store.get_own_blocks(cursor, batch_size)
-            if blocks:
-                cursor = max(b.round() for b in blocks)
-                await self.connection.send(
-                    self._blocks_message(tuple(b.to_bytes() for b in blocks))
-                )
+            frame, cursor, _count = self._push_frame("own", None, cursor)
+            if frame is not None:
+                await self.connection.send(frame)
             else:
                 try:
                     await asyncio.wait_for(

@@ -456,18 +456,24 @@ class StatementBlock:
         cls._decode_memo = None
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "StatementBlock":
-        """Single-pass inline decoder.
+    def from_bytes(cls, data) -> "StatementBlock":
+        """Single-pass inline decoder over ``bytes`` or any buffer view.
 
         Wire format identical to the Reader-based encoders above; the
         per-field Reader method calls dominated the receive-path profile at
         load (millions of ``_take`` calls), so this path unpacks with local
         offsets.  Error semantics match: any truncation, bad tag, invalid
-        vote byte, or trailing garbage raises SerdeError."""
+        vote byte, or trailing garbage raises SerdeError.
+
+        Memoryview inputs (the zero-copy receive path: block payloads are
+        sub-views over a connection's reusable frame buffer) are
+        materialized EXACTLY ONCE here — the copy that becomes the cached
+        canonical serialization the digest and signature cover; nothing
+        downstream retains a view of the caller's buffer."""
+        if type(data) is not bytes:  # memoryview/mmap callers
+            data = bytes(data)
         memo = cls._decode_memo
         if memo is not None:
-            if not isinstance(data, bytes):  # mmap/memoryview callers
-                data = bytes(data)
             cached = memo.get(data)
             if cached is not None:
                 return cached

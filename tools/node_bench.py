@@ -131,6 +131,108 @@ def saturation(verifier: str, batch: int = 4096, iters: int = 5) -> dict:
     }
 
 
+def mesh_serialization(peers: int = 9, blocks: int = 50, txs: int = 16,
+                       iters: int = 30) -> dict:
+    """Mesh-serialization microbench: encode-once fan-out vs per-peer.
+
+    Measures exactly the work ``write_loop`` does when a dissemination
+    frame fans out to ``peers`` subscribers: the legacy path encodes the
+    frame once PER PEER; the broadcast-once path encodes once and ships the
+    cached payload (``EncodedFrame``).  Reports MB/s of fan-out payload
+    production plus interpreter allocation counts — the second number is
+    the GC-pressure story the throughput number hides.  Uses the
+    ``mysticeti_tpu.crypto`` signers (pure-Python RFC 8032 fallback) so the
+    rung runs on hosts without the ``cryptography`` package.
+    """
+    import time
+
+    from mysticeti_tpu.committee import Committee
+    from mysticeti_tpu.network import Blocks, EncodedFrame, encode_message, frame_payload
+    from mysticeti_tpu.types import Share, StatementBlock
+
+    signers = Committee.benchmark_signers(4)
+    genesis = [StatementBlock.new_genesis(a).reference for a in range(4)]
+    batch = tuple(
+        StatementBlock.build(
+            0, 1 + i, genesis, [Share(bytes(128) + i.to_bytes(4, "little"))] * txs,
+            signer=signers[0],
+        ).to_bytes()
+        for i in range(blocks)
+    )
+    msg = Blocks(batch)
+    frame_bytes = len(encode_message(msg))
+    shipped = frame_bytes * peers * iters
+
+    def measure(fn, encodes_per_fanout):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        elapsed = time.perf_counter() - t0
+        return {
+            "mb_per_s": round(shipped / 1e6 / elapsed, 1),
+            # Every encoded byte is an allocated byte: the fan-out's
+            # allocation volume is encodes × frame size (the GC-pressure
+            # story the throughput number hides).
+            "encodes_per_fanout": encodes_per_fanout,
+            "alloc_bytes_per_fanout": encodes_per_fanout * frame_bytes,
+            "elapsed_s": round(elapsed, 4),
+        }
+
+    def per_peer():
+        for _ in range(peers):
+            encode_message(msg)
+
+    def encode_once():
+        frame = EncodedFrame(msg)
+        for _ in range(peers):
+            frame_payload(frame)
+
+    per_peer_row = measure(per_peer, peers)
+    encode_once_row = measure(encode_once, 1)
+    return {
+        "metric": "mesh_serialization_fanout",
+        "peers": peers,
+        "blocks_per_frame": blocks,
+        "frame_bytes": frame_bytes,
+        "iters": iters,
+        "per_peer": per_peer_row,
+        "encode_once": encode_once_row,
+        "speedup": round(
+            encode_once_row["mb_per_s"] / max(per_peer_row["mb_per_s"], 1e-9), 2
+        ),
+    }
+
+
+def append_mesh_trend(row: dict, round_: int) -> None:
+    """Track the fan-out win round-over-round in BENCH_TREND.json under its
+    own MESH_SERIALIZATION family (never mixed with the fleet families —
+    a serialization microbench must not gate a fleet search and vice
+    versa)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench_trend
+
+    source = f"MESH_SERIALIZATION_r{round_:02d}.json"
+    fresh = [
+        bench_trend._record(
+            round_, source, "MESH_SERIALIZATION.encode_once_mb_s",
+            row["encode_once"]["mb_per_s"], "MB/s",
+        ),
+        bench_trend._record(
+            round_, source, "MESH_SERIALIZATION.per_peer_mb_s",
+            row["per_peer"]["mb_per_s"], "MB/s",
+        ),
+        bench_trend._record(
+            round_, source, "MESH_SERIALIZATION.fanout_speedup",
+            row["speedup"], "x",
+        ),
+    ]
+    path = os.environ.get("BENCH_TREND_PATH", "BENCH_TREND.json")
+    index = bench_trend.load_index(path)
+    if bench_trend.merge_index(index, fresh):
+        bench_trend.write_index(index, path)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--nodes", type=int, default=4)
@@ -142,7 +244,24 @@ def main() -> None:
         "--verifiers", nargs="+", default=["cpu", "tpu"],
         choices=["accept", "cpu", "tpu", "tpu-only"],
     )
+    parser.add_argument(
+        "--mesh-bench", action="store_true",
+        help="run ONLY the mesh-serialization microbench (encode-once vs "
+        "per-peer fan-out) and append it to BENCH_TREND.json under the "
+        "MESH_SERIALIZATION family",
+    )
+    parser.add_argument(
+        "--round", type=int, default=10,
+        help="PR round recorded with --mesh-bench trend records",
+    )
     args = parser.parse_args()
+
+    if args.mesh_bench:
+        row = mesh_serialization()
+        print(json.dumps(row, indent=2))
+        append_mesh_trend(row, args.round)
+        print("appended MESH_SERIALIZATION records to BENCH_TREND.json")
+        return
 
     if any(v.startswith("tpu") for v in args.verifiers):
         print("prewarming fused kernel cache...", flush=True)
