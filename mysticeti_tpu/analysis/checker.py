@@ -112,6 +112,17 @@ GUARDED_FIELDS: Dict[str, str] = {
     # reassignment must happen under the table lock or a reader resolves a
     # position against a half-swapped table.
     "_segments": "_seg_lock",
+    # Ingress mempool accounting (ingress.Mempool): the pool's aggregate
+    # transaction/byte counters move with the lane deques — submissions may
+    # arrive from application threads while the core drains on the loop, so
+    # every read-modify-write must hold the mempool lock or the caps drift.
+    "_mempool_count": "_mempool_lock",
+    "_mempool_bytes": "_mempool_lock",
+    # Ingress admission token bucket (ingress.AdmissionController): admit()
+    # rides the thread-capable submit path while tick() adjusts the rate on
+    # the loop — an unguarded spend would let two concurrent admits both
+    # read the same balance and double the admitted rate.
+    "_tokens": "_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

@@ -100,11 +100,23 @@ class BlockHandler:
 
 class _LoggingAggregator(TransactionAggregator):
     """TransactionAggregator whose processed-hook appends to a TransactionLog
-    (committee.rs:297-312 handler seam with the log.rs sink)."""
+    (committee.rs:297-312 handler seam with the log.rs sink).
 
-    def __init__(self, log: Optional[TransactionLog]) -> None:
+    Duplicate/unknown observations count on
+    ``mysticeti_transaction_dedup_total{kind}`` — previously they were log
+    lines (or a raise) only, so a fleet absorbing duplicate floods was
+    indistinguishable from one that never saw them."""
+
+    def __init__(
+        self, log: Optional[TransactionLog], metrics=None
+    ) -> None:
         super().__init__(QUORUM, track_processed=log is None)
         self._log = log
+        self._metrics = metrics
+
+    def _count_dedup(self, kind: str) -> None:
+        if self._metrics is not None:
+            self._metrics.mysticeti_transaction_dedup_total.labels(kind).inc()
 
     def transaction_processed(self, k: TransactionLocator) -> None:
         if self._log is not None:
@@ -119,10 +131,12 @@ class _LoggingAggregator(TransactionAggregator):
             super().transaction_processed_range(block, start, end)
 
     def duplicate_transaction(self, k, from_) -> None:
+        self._count_dedup("duplicate")
         if self._log is None:
             super().duplicate_transaction(k, from_)
 
     def unknown_transaction(self, k, from_) -> None:
+        self._count_dedup("unknown")
         if self._log is None:
             super().unknown_transaction(k, from_)
 
@@ -143,9 +157,10 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         block_store=None,
         metrics=None,
         transaction_time: Optional[Dict[BlockReference, float]] = None,
+        ingress=None,
     ) -> None:
         log = TransactionLog.start(certified_log_path) if certified_log_path else None
-        self.transaction_votes = _LoggingAggregator(log)
+        self.transaction_votes = _LoggingAggregator(log, metrics=metrics)
         # Keyed per OWN proposal block: all shares of a block are drained
         # at one moment, so one stamp covers the whole run.
         self.transaction_time: Dict[BlockReference, float] = (
@@ -158,14 +173,40 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         self.metrics = metrics
         self._queue: Deque[List[bytes]] = deque()
         self._queue_lock = threading.Lock()
+        # Legacy-path deferral accounting: length of the already-counted
+        # deferred remainder sitting at the FRONT of the queue (appendleft
+        # puts it there), so a batch re-truncated across several proposals
+        # counts each transaction's deferral once, not once per proposal.
+        self._deferred_counted = 0
         self.pending_transactions = 0
         self.consensus_only = "CONSENSUS_ONLY" in os.environ
+        # Ingress plane (ingress.IngressPlane): when wired, submissions run
+        # through the admission-controlled mempool (dedup, fairness lanes,
+        # typed shedding) and proposals drain weighted-round-robin from it.
+        # None = the legacy unbounded direct queue.
+        self.ingress = ingress
 
-    # -- ingestion from the generator --
+    # -- ingestion from the generator / gateway --
 
-    def submit(self, transactions: List[bytes]) -> None:
+    def submit(self, transactions: List[bytes]):
+        """Submit transactions for proposal.  With an ingress plane wired,
+        returns its typed :class:`~mysticeti_tpu.ingress.SubmitResult`
+        (ACK/QUEUED/SHED) — closed-loop clients consume it; legacy callers
+        may ignore the return value (the pre-ingress contract returned
+        None)."""
+        if self.ingress is not None:
+            return self.ingress.submit("local", transactions)
         with self._queue_lock:
             self._queue.append(transactions)
+        return None
+
+    def _proposal_budget(self) -> int:
+        cap = SOFT_MAX_PROPOSED_PER_BLOCK
+        if self.ingress is not None and self.ingress.max_per_proposal:
+            cap = min(
+                max(1, self.ingress.max_per_proposal), MAX_PROPOSED_PER_BLOCK
+            )
+        return cap - self.pending_transactions
 
     def _receive_with_limit(self) -> Optional[List[bytes]]:
         """Drain up to the SOFT_MAX budget, SLICING oversize submissions: the
@@ -174,16 +215,37 @@ class BenchmarkFastPathBlockHandler(BlockHandler):
         let every block overshoot the cap by the chunk size — turning the
         block_handler.rs SOFT_MAX semantics (a per-block transaction cap)
         into a no-op whenever tps/10 > SOFT_MAX.  The unconsumed remainder
-        stays queued for the next proposal."""
-        if self.pending_transactions >= SOFT_MAX_PROPOSED_PER_BLOCK:
+        stays queued for the next proposal — visible on
+        ``mysticeti_ingress_shed_total{soft_cap_deferred}`` (deferred, not
+        lost; previously this truncation was silent)."""
+        budget = self._proposal_budget()
+        if budget <= 0:
             return None
-        budget = SOFT_MAX_PROPOSED_PER_BLOCK - self.pending_transactions
+        if self.ingress is not None:
+            received = self.ingress.drain(budget)
+            if not received:
+                return None
+            self.pending_transactions += len(received)
+            return received
         with self._queue_lock:
             if not self._queue:
                 return None
             received = self._queue.popleft()
+            already_counted = self._deferred_counted
+            self._deferred_counted = 0
             if len(received) > budget:
+                remainder = len(received) - budget
                 self._queue.appendleft(received[budget:])
+                # Only transactions ENTERING deferral count: the front batch
+                # may itself be a previously-deferred (and counted)
+                # remainder, and re-counting it every proposal would inflate
+                # the series past the number of offered transactions.
+                newly = remainder - max(0, already_counted - budget)
+                self._deferred_counted = remainder
+                if newly > 0 and self.metrics is not None:
+                    self.metrics.mysticeti_ingress_shed_total.labels(
+                        "soft_cap_deferred"
+                    ).inc(newly)
                 received = received[:budget]
         self.pending_transactions += len(received)
         return received

@@ -77,6 +77,18 @@ def _apply_storage_overrides(parameters: Parameters, args) -> None:
         storage.snapshot_catchup = True
     if getattr(args, "timestamp_frames", False):
         parameters.synchronizer.timestamp_frames = True
+    # Ingress-plane flags (one IngressParameters block, config.py).
+    ingress = parameters.ingress
+    if getattr(args, "no_ingress", False):
+        ingress.enabled = False
+    if getattr(args, "gateway_port_base", None) is not None:
+        ingress.gateway_port_base = args.gateway_port_base
+    if getattr(args, "mempool_max_transactions", None) is not None:
+        ingress.mempool_max_transactions = args.mempool_max_transactions
+    if getattr(args, "admission_initial", None) is not None:
+        ingress.admission_initial_tx_s = float(args.admission_initial)
+    if getattr(args, "no_admission", False):
+        ingress.admission = False
 
 
 async def run_node(
@@ -235,6 +247,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                        "(wire tag 12): peers surface per-link transit and "
                        "the fleet-trace merger can align cross-node clocks "
                        "(docs/fleet-tracing.md)")
+        # Ingress plane (docs/ingress.md).
+        p.add_argument("--no-ingress", action="store_true",
+                       help="disable the admission-controlled ingress plane "
+                       "(restores the pre-r11 unbounded direct queue)")
+        p.add_argument("--no-admission", action="store_true",
+                       help="keep the bounded mempool but disable the AIMD "
+                       "admission controller (pool caps still shed)")
+        p.add_argument("--gateway-port-base", type=int, default=None,
+                       help="serve the client RPC gateway on port "
+                       "BASE+authority (wire tags 13-16; 0/unset = off)")
+        p.add_argument("--mempool-max-transactions", type=int, default=None,
+                       help="ingress mempool transaction cap (submissions "
+                       "beyond it are SHED with a typed reject)")
+        p.add_argument("--admission-initial", type=float, default=None,
+                       help="initial AIMD-admitted rate ceiling, tx/s")
 
     r = sub.add_parser("run", help="run one validator")
     r.add_argument("--authority", type=int, required=True)
@@ -305,6 +332,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     ch.add_argument("--health-out", default=None,
                     help="write the deterministic health timeline + SLO "
                     "alert stream as JSON")
+
+    ov = sub.add_parser(
+        "overload",
+        help="deterministic overload sim: seeded N-node fleet under an "
+        "offered-load multiplier ramp through the admission-controlled "
+        "ingress plane; prints committed-vs-offered, the shed ledger, and "
+        "the byte-stable shed-schedule digest (docs/ingress.md)",
+    )
+    ov.add_argument("--seed", type=int, default=0)
+    ov.add_argument("--nodes", type=int, default=10)
+    ov.add_argument("--duration", type=float, default=15.0,
+                    help="virtual seconds to simulate")
+    ov.add_argument("--base-tps", type=int, default=300,
+                    help="per-node offered load at 1x")
+    ov.add_argument("--schedule", default="0:3",
+                    help="offered-load multiplier ramp, t:mult pairs "
+                    "(e.g. '0:1,5:3,10:5')")
+    ov.add_argument("--clients", type=int, default=3,
+                    help="fairness lanes per node")
+    ov.add_argument("--closed-loop", action="store_true",
+                    help="clients consume SHED/retry-after verdicts")
+    ov.add_argument("--report-out", default=None,
+                    help="write the full report JSON here")
 
     vs = sub.add_parser(
         "verifier-service",
@@ -381,6 +431,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.command == "chaos":
         return run_chaos(args)
+    if args.command == "overload":
+        return run_overload(args)
     if args.command == "verifier-service":
         from .verifier_service import run_service
 
@@ -468,6 +520,63 @@ def run_chaos(args) -> int:
             f.write("\n")
         print(f"health timeline written to {args.health_out}")
     print("safety: OK (identical committed prefixes on all nodes)")
+    return 0
+
+
+def run_overload(args) -> int:
+    """The `overload` subcommand: one seeded overload scenario on the
+    deterministic simulator (docs/ingress.md).  Commit safety under
+    overload is audited by the chaos SafetyChecker inside the runner."""
+    import json
+
+    from .ingress import OverloadScenario, run_overload_sim
+    from .transactions_generator import parse_overload_schedule
+
+    scenario = OverloadScenario(
+        seed=args.seed,
+        nodes=args.nodes,
+        duration_s=args.duration,
+        base_tps=args.base_tps,
+        multiplier_schedule=parse_overload_schedule(args.schedule),
+        clients_per_node=args.clients,
+        closed_loop=args.closed_loop,
+        max_per_proposal=30,
+        mempool_max_transactions=600,
+    )
+    report = run_overload_sim(scenario)
+    print(
+        f"committed: {report.committed_tx} tx "
+        f"({report.committed_tx_s:.1f} tx/s) of {report.offered_tx} offered "
+        f"({report.admitted_tx} admitted)"
+    )
+    for reason, count in sorted(report.shed_by_reason.items()):
+        print(f"shed[{reason}]: {count}")
+    for lane, stats in sorted(report.lane_stats.items()):
+        print(
+            f"lane {lane}: drained={stats['drained']} shed={stats['shed']}"
+            f" pending={stats['pending']}"
+        )
+    print(f"shed schedule digest: {report.shed_schedule_digest}")
+    print("safety: OK (identical committed prefixes on all nodes)")
+    if args.report_out:
+        with open(args.report_out, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "scenario": scenario.to_dict(),
+                    "committed_tx": report.committed_tx,
+                    "committed_tx_s": report.committed_tx_s,
+                    "offered_tx": report.offered_tx,
+                    "admitted_tx": report.admitted_tx,
+                    "shed_by_reason": report.shed_by_reason,
+                    "shed_schedule_digest": report.shed_schedule_digest,
+                    "lane_stats": report.lane_stats,
+                    "commit_heights": report.commit_heights,
+                    "generator_stats": report.generator_stats,
+                },
+                f, indent=1,
+            )
+            f.write("\n")
+        print(f"report written to {args.report_out}")
     return 0
 
 

@@ -47,6 +47,10 @@ class CommitObserver:
     # lifecycle signal the incident ring keeps, at commit (not per-block)
     # granularity.
     recorder = None
+    # Ingress plane (ingress.IngressPlane), wired post-construction like the
+    # recorder: the committed sequence feeds gateway commit notifications
+    # and the admission controller's progress signal.
+    ingress = None
 
     def _record_committed(self, committed: List[CommittedSubDag]) -> None:
         if self.recorder is not None and committed:
@@ -57,6 +61,8 @@ class CommitObserver:
                 sub_dags=len(committed),
                 anchor=spans.format_ref(last.anchor),
             )
+        if self.ingress is not None and committed:
+            self.ingress.note_committed(committed)
 
     def handle_commit(
         self, committed_leaders: List[StatementBlock]

@@ -100,6 +100,76 @@ class StorageParameters:
 
 
 @dataclass
+class IngressParameters:
+    """The overload-resilient ingress plane's knobs (ingress.py).
+
+    Transactions used to enter through ``BenchmarkFastPathBlockHandler.submit``
+    into an UNBOUNDED queue with nothing but the per-block SOFT_MAX drain cap:
+    past saturation the queue (and end-to-end latency) grew without limit and
+    committed throughput collapsed (MAXLOAD r4: 40.3k committed at 57.6k
+    offered).  This block configures the bounded, admission-controlled mempool
+    and the client gateway that replace it:
+
+    * ``mempool_max_transactions`` / ``mempool_max_bytes`` — hard caps on the
+      pool; submissions beyond them are SHED with a typed reject, never
+      silently queued or dropped.
+    * ``lane_max_transactions`` — per-client fairness-lane cap.  The
+      default equals the pool cap (single-tenant benchmark profile: the one
+      generator lane may use the whole pool, so the POOL watermark — the
+      AIMD congestion signal — is reachable); multi-tenant deployments set
+      it lower so one flooding client fills its own lane, not the pool.
+    * ``priority_weight`` — weighted-round-robin drain weight of priority
+      lanes relative to normal ones.
+    * ``dedup_window`` — recently-admitted transaction keys remembered for
+      nonce/digest dedup (count-bounded so seeded sims stay deterministic).
+    * ``admission`` — arm the AIMD admission controller: the admitted rate
+      closes the loop from live core signals (WAL backlog, core owner queue
+      depth, verifier pipeline occupancy, mempool occupancy) so at 2-5x
+      offered overload the core keeps running at its measured saturation
+      point instead of collapsing.
+    * ``admission_initial_tx_s`` / ``admission_min_tx_s`` /
+      ``admission_additive_tx_s`` / ``admission_decrease_factor`` — AIMD
+      shape: additive raise per tick while healthy, multiplicative cut on
+      congestion, floor so a transient stall cannot starve ingress forever.
+    * ``high_watermark`` / ``low_watermark`` — mempool occupancy fractions:
+      above high = congested (cut), below low = recovered (raise); between
+      them the rate holds (hysteresis, so the controller cannot flap).
+    * ``queued_watermark`` — occupancy above which an accepted submission is
+      acknowledged QUEUED instead of ACK (the gateway's early-backpressure
+      hint to well-behaved clients).
+    * ``max_per_proposal`` — per-proposal drain budget (0 = the handler's
+      SOFT_MAX); sims use a small value to reproduce saturation in virtual
+      time.
+    * ``gateway_port_base`` — when > 0, serve the client RPC gateway on
+      ``gateway_port_base + authority`` (wire tags 13-16,
+      docs/wire-format.md); 0 = no gateway listener.
+    * ``tick_interval_s`` — admission controller cadence.
+    * ``shed_log_capacity`` — bounded structured shed log (the deterministic
+      overload sim asserts it byte-identical across same-seed runs).
+    """
+
+    enabled: bool = True
+    mempool_max_transactions: int = 200_000
+    mempool_max_bytes: int = 256 * 1024 * 1024
+    lane_max_transactions: int = 200_000
+    priority_weight: int = 4
+    dedup_window: int = 100_000
+    admission: bool = True
+    admission_initial_tx_s: float = 100_000.0
+    admission_min_tx_s: float = 500.0
+    admission_max_tx_s: float = 1_000_000.0
+    admission_additive_tx_s: float = 1_000.0
+    admission_decrease_factor: float = 0.7
+    high_watermark: float = 0.85
+    low_watermark: float = 0.5
+    queued_watermark: float = 0.5
+    max_per_proposal: int = 0
+    gateway_port_base: int = 0
+    tick_interval_s: float = 0.5
+    shed_log_capacity: int = 10_000
+
+
+@dataclass
 class Parameters:
     identifiers: List[Identifier] = field(default_factory=list)
     wave_length: int = 3
@@ -116,6 +186,7 @@ class Parameters:
     store_retain_rounds: Optional[int] = None
     storage: StorageParameters = field(default_factory=StorageParameters)
     synchronizer: SynchronizerParameters = field(default_factory=SynchronizerParameters)
+    ingress: IngressParameters = field(default_factory=IngressParameters)
     network_connection_max_latency_s: float = 5.0
 
     def __post_init__(self) -> None:
@@ -181,9 +252,13 @@ class Parameters:
             raw = yaml.safe_load(f)
         sync = SynchronizerParameters(**raw.pop("synchronizer", {}))
         storage = StorageParameters(**raw.pop("storage", {}))
+        # Absent on pre-r11 parameter files: defaults apply (the ingress
+        # plane is on with generous caps, same as a fresh genesis).
+        ingress = IngressParameters(**raw.pop("ingress", {}))
         identifiers = [Identifier(**i) for i in raw.pop("identifiers", [])]
         return cls(
-            identifiers=identifiers, synchronizer=sync, storage=storage, **raw
+            identifiers=identifiers, synchronizer=sync, storage=storage,
+            ingress=ingress, **raw
         )
 
 

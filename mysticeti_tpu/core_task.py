@@ -55,6 +55,16 @@ class CoreTaskDispatcher:
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
+    def queue_depth(self) -> int:
+        """Commands waiting for the consensus owner — the ingress plane's
+        core-congestion tap (a persistently deep queue means intake is
+        outrunning the single-owner pipeline)."""
+        return self._queue.qsize()
+
+    @property
+    def queue_capacity(self) -> int:
+        return CORE_QUEUE_SIZE
+
     @staticmethod
     def _default_fatal() -> None:
         import os

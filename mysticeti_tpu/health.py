@@ -256,6 +256,7 @@ class HealthProbe:
         self._net_syncer = None
         self._block_verifier = None
         self._commit_observer = None
+        self._ingress = None
         self._task: Optional[asyncio.Task] = None
         # Rate state.
         self._last_t: Optional[float] = None
@@ -278,6 +279,7 @@ class HealthProbe:
         net_syncer=None,
         block_verifier=None,
         commit_observer=None,
+        ingress=None,
     ) -> "HealthProbe":
         if core is not None:
             self._core = core
@@ -287,6 +289,8 @@ class HealthProbe:
             self._block_verifier = block_verifier
         if commit_observer is not None:
             self._commit_observer = commit_observer
+        if ingress is not None:
+            self._ingress = ingress
         return self
 
     def detach(self) -> None:
@@ -296,6 +300,7 @@ class HealthProbe:
         self._net_syncer = None
         self._block_verifier = None
         self._commit_observer = None
+        self._ingress = None
 
     def attach_critical_path(self, tracer) -> "HealthProbe":
         """Subscribe a critical-path analyzer to the span stream."""
@@ -409,6 +414,11 @@ class HealthProbe:
         }
         if verifier_state is not None:
             snapshot["verifier"] = verifier_state
+        if self._ingress is not None:
+            # Admission state in the /health diagnosis: a degraded node that
+            # is SHEDDING reads differently from one silently drowning —
+            # the whole point of the ingress plane (ingress.py).
+            snapshot["ingress"] = self._ingress.health_state()
         alerts = self._watchdog(snapshot, lags)
         snapshot["status"] = "degraded" if self._firing else "ok"
         self._export_gauges(snapshot, lags)

@@ -449,6 +449,55 @@ class Metrics:
                      5.0, 10.0, 30.0],
         )
 
+        # Overload-resilient ingress plane (ingress.py): the admission-
+        # controlled mempool's accounting.  Every transaction a node refuses
+        # is on mysticeti_ingress_shed_total — silent drops were the PR 10
+        # connection_send_drops_total lesson.
+        self.mysticeti_ingress_shed_total = counter(
+            "mysticeti_ingress_shed_total",
+            "transactions refused (or deferred) by the ingress plane, by "
+            "reason: admission (AIMD rate), mempool_transactions / "
+            "mempool_bytes (pool caps), lane_cap (per-client fairness "
+            "lane), duplicate (dedup window), notify_backpressure (commit "
+            "notifications a slow gateway client lost), soft_cap_deferred "
+            "(re-queued for the NEXT proposal — deferred, not lost)",
+            labels=("reason",),
+        )
+        self.mysticeti_ingress_admitted_total = counter(
+            "mysticeti_ingress_admitted_total",
+            "transactions admitted into the mempool (offered = admitted + "
+            "shed, per the typed SubmitResult contract)",
+        )
+        self.mysticeti_ingress_admitted_rate = gauge(
+            "mysticeti_ingress_admitted_rate",
+            "current AIMD-admitted transaction rate ceiling (tx/s) — cut "
+            "multiplicatively on core congestion, raised additively while "
+            "healthy",
+        )
+        self.mysticeti_ingress_mempool_transactions = gauge(
+            "mysticeti_ingress_mempool_transactions",
+            "transactions pending in the bounded ingress mempool",
+        )
+        self.mysticeti_ingress_mempool_bytes = gauge(
+            "mysticeti_ingress_mempool_bytes",
+            "bytes pending in the bounded ingress mempool",
+        )
+        self.mysticeti_ingress_shed_mode = gauge(
+            "mysticeti_ingress_shed_mode",
+            "1 while the admission controller is in shed mode (congestion "
+            "detected; transitions land in the flight recorder)",
+        )
+        self.mysticeti_ingress_gateway_clients = gauge(
+            "mysticeti_ingress_gateway_clients",
+            "live client connections on the ingress gateway listener",
+        )
+        self.mysticeti_transaction_dedup_total = counter(
+            "mysticeti_transaction_dedup_total",
+            "duplicate/unknown transaction observations in the fast-path "
+            "vote aggregator (previously log lines only)",
+            labels=("kind",),
+        )
+
         # Robustness / chaos engineering.
         self.crash_recovery_total = counter(
             "crash_recovery_total",

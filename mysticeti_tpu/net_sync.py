@@ -246,6 +246,17 @@ class NetworkSyncer:
     async def await_completion(self) -> None:
         await self._stopped.wait()
 
+    def backpressure(self) -> Dict[str, object]:
+        """Live core backpressure signals for the ingress plane's admission
+        controller (ingress.py): the consensus owner's queue depth and the
+        WAL appender's drain state — cheap reads of state the node already
+        maintains, no new bookkeeping."""
+        return {
+            "core_queue_depth": self.dispatcher.queue_depth(),
+            "core_queue_capacity": self.dispatcher.queue_capacity,
+            "wal_backlog": bool(self.core.wal_writer.pending()),
+        }
+
     # -- connection handling --
 
     async def _accept_loop(self) -> None:

@@ -100,6 +100,15 @@ _MSG_REQUEST_SNAPSHOT = 9
 _MSG_SNAPSHOT = 10
 _MSG_REQUEST_SNAPSHOT_STREAM = 11
 _MSG_BLOCKS_TIMESTAMPED = 12
+# Client gateway tags (ingress.py).  These ride the same length-prefixed
+# framing and codec but flow ONLY on the gateway listener (client <->
+# validator), never on the validator mesh — a mesh peer that predates them
+# would reset the connection per the §7 soft-extension rule, and none is
+# ever emitted there.
+_MSG_GATEWAY_SUBMIT = 13
+_MSG_GATEWAY_SUBMIT_REPLY = 14
+_MSG_GATEWAY_SUBSCRIBE_COMMITS = 15
+_MSG_GATEWAY_COMMITS = 16
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,6 +212,57 @@ class BlockNotFound:
 
 
 @dataclasses.dataclass(frozen=True)
+class GatewaySubmit:
+    """Client -> gateway: submit transactions to the admission-controlled
+    mempool (wire tag 13, docs/wire-format.md §5b).  ``client`` names the
+    fairness lane (empty = the connection's own lane); ``priority`` != 0
+    asks for the priority drain class (subject to the lane caps — priority
+    weights the round-robin, it does not bypass admission)."""
+
+    client: bytes
+    priority: int
+    transactions: Tuple[bytes, ...]
+
+
+# GatewaySubmitReply.status values (SUBMIT -> ACK/QUEUED/SHED).
+GATEWAY_ACK = 0  # all accepted, mempool shallow
+GATEWAY_QUEUED = 1  # all accepted, mempool past the queued watermark: slow down
+GATEWAY_SHED = 2  # some/all rejected; retry_after_ms + reason say why/when
+
+
+@dataclasses.dataclass(frozen=True)
+class GatewaySubmitReply:
+    """Gateway -> client: the typed submission verdict (wire tag 14).  A
+    SHED reply is the explicit-backpressure contract: ``retry_after_ms``
+    tells a closed-loop client when the admission controller expects
+    capacity, ``reason`` (utf-8) names the first rejection cause."""
+
+    status: int
+    accepted: int
+    shed: int
+    retry_after_ms: int
+    reason: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class GatewaySubscribeCommits:
+    """Client -> gateway: stream commit notifications from ``from_height``
+    (exclusive) on (wire tag 15).  Notifications carry the 16-byte ingress
+    keys of committed transactions, the same keys the mempool dedups on."""
+
+    from_height: int
+
+
+@dataclasses.dataclass(frozen=True)
+class GatewayCommitNotification:
+    """Gateway -> client: transactions sequenced by the committed sub-dag at
+    ``height`` (wire tag 16), identified by their 16-byte ingress keys."""
+
+    height: int
+    keys: Tuple[bytes, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Ping:
     nanos: int
 
@@ -254,6 +314,21 @@ def encode_message(msg: NetworkMessage) -> bytes:
         w.u8(_MSG_SNAPSHOT).bytes(msg.manifest)
     elif isinstance(msg, RequestSnapshotStream):
         w.u8(_MSG_REQUEST_SNAPSHOT_STREAM).u64(msg.from_round)
+    elif isinstance(msg, GatewaySubmit):
+        w.u8(_MSG_GATEWAY_SUBMIT).bytes(msg.client).u8(1 if msg.priority else 0)
+        w.u32(len(msg.transactions))
+        for tx in msg.transactions:
+            w.bytes(tx)
+    elif isinstance(msg, GatewaySubmitReply):
+        w.u8(_MSG_GATEWAY_SUBMIT_REPLY).u8(msg.status)
+        w.u32(msg.accepted).u32(msg.shed).u64(msg.retry_after_ms)
+        w.bytes(msg.reason)
+    elif isinstance(msg, GatewaySubscribeCommits):
+        w.u8(_MSG_GATEWAY_SUBSCRIBE_COMMITS).u64(msg.from_height)
+    elif isinstance(msg, GatewayCommitNotification):
+        w.u8(_MSG_GATEWAY_COMMITS).u64(msg.height).u32(len(msg.keys))
+        for key in msg.keys:
+            w.bytes(key)
     else:  # pragma: no cover
         raise SerdeError(f"unknown message {type(msg)}")
     return w.finish()
@@ -300,6 +375,25 @@ def decode_message(data) -> NetworkMessage:
             tuple(r.bytes() for _ in range(r.u32())),
             sent_monotonic_ns=monotonic_ns,
             sent_wall_ns=wall_ns,
+        )
+    elif tag == _MSG_GATEWAY_SUBMIT:
+        # Materialized (never views): submitted transactions outlive the
+        # receive buffer — they sit in the mempool until proposed.
+        client = bytes(r.bytes())
+        priority = r.u8()
+        msg = GatewaySubmit(
+            client, priority, tuple(bytes(r.bytes()) for _ in range(r.u32()))
+        )
+    elif tag == _MSG_GATEWAY_SUBMIT_REPLY:
+        msg = GatewaySubmitReply(
+            r.u8(), r.u32(), r.u32(), r.u64(), bytes(r.bytes())
+        )
+    elif tag == _MSG_GATEWAY_SUBSCRIBE_COMMITS:
+        msg = GatewaySubscribeCommits(r.u64())
+    elif tag == _MSG_GATEWAY_COMMITS:
+        height = r.u64()
+        msg = GatewayCommitNotification(
+            height, tuple(bytes(r.bytes()) for _ in range(r.u32()))
         )
     else:
         raise SerdeError(f"unknown message tag {tag}")

@@ -37,6 +37,7 @@ from .core import Core, CoreOptions
 from .crypto import Signer
 from .flight_recorder import FlightRecorder, path_from_env
 from .health import HealthProbe, SLOThresholds
+from .ingress import IngressGateway, IngressPlane
 from .metrics import MetricReporter, Metrics, serve_metrics
 from .net_sync import NetworkSyncer
 from .tracing import current_authority, logger, setup_logging
@@ -152,6 +153,8 @@ class Validator:
         self.core: Optional[Core] = None
         self.health: Optional[HealthProbe] = None
         self.recorder: Optional[FlightRecorder] = None
+        self.ingress: Optional[IngressPlane] = None
+        self.gateway: Optional[IngressGateway] = None
 
     def _make_recorder(self, authority: int, lifecycle, observer):
         """The always-on flight recorder: ring in memory unconditionally,
@@ -241,12 +244,22 @@ class Validator:
         (recovered, observer_recovered, wal_writer, lifecycle) = cls.init_storage(
             authority, committee, private, parameters, v.metrics
         )
+        # Overload-resilient ingress plane (ingress.py): every submission —
+        # generator or gateway client — runs through the admission-controlled
+        # mempool; proposals drain weighted-round-robin from it.
+        plane = (
+            IngressPlane(parameters.ingress, authority=authority,
+                         metrics=v.metrics)
+            if parameters.ingress.enabled
+            else None
+        )
         handler = BenchmarkFastPathBlockHandler(
             committee,
             authority,
             certified_log_path=private.certified_transactions_log(),
             block_store=recovered.block_store,
             metrics=v.metrics,
+            ingress=plane,
         )
         core = Core(
             block_handler=handler,
@@ -276,6 +289,12 @@ class Validator:
         )
         recorder = v._make_recorder(authority, lifecycle, observer)
         block_verifier = _make_verifier(verifier, committee, v.metrics)
+        # Overload modes (tools/overload_bench.py drives these through the
+        # environment): an offered-load multiplier schedule and a closed
+        # loop that consumes the ingress plane's SHED/retry-after verdicts.
+        from .transactions_generator import parse_overload_schedule
+
+        schedule_env = os.environ.get("MYSTICETI_OVERLOAD_SCHEDULE")
         v.generator = TransactionGenerator(
             submit=handler.submit,
             seed=authority,
@@ -283,6 +302,13 @@ class Validator:
             transaction_size=transaction_size,
             initial_delay_s=float(os.environ.get("INITIAL_DELAY", "2")),
             ready=block_verifier.ready.is_set,
+            overload_schedule=(
+                parse_overload_schedule(schedule_env) if schedule_env else None
+            ),
+            closed_loop=(
+                os.environ.get("MYSTICETI_CLOSED_LOOP", "") == "1"
+                and plane is not None
+            ),
         )
         if network is None:
             network = await TcpNetwork.start(
@@ -305,6 +331,24 @@ class Validator:
         v.generator.start()
         v.reporter = MetricReporter(v.metrics).start()
         v._start_health(authority, committee, observer, block_verifier)
+        if plane is not None:
+            plane.recorder = recorder
+            observer.ingress = plane
+            plane.attach(
+                core=core,
+                net_syncer=v.network_syncer,
+                block_verifier=block_verifier,
+                health=v.health,
+            )
+            if v.health is not None:
+                v.health.attach(ingress=plane)
+            v.ingress = plane.start()
+            if parameters.ingress.gateway_port_base:
+                v.gateway = await IngressGateway(
+                    plane,
+                    "0.0.0.0",
+                    parameters.ingress.gateway_port_base + authority,
+                ).start()
         if serve_metrics_endpoint and parameters.identifiers:
             host, port = parameters.metrics_address(authority)
             v._metrics_server = await serve_metrics(
@@ -384,6 +428,10 @@ class Validator:
     async def stop(self) -> None:
         if self.generator is not None:
             self.generator.stop()
+        if self.gateway is not None:
+            await self.gateway.stop()
+        if self.ingress is not None:
+            self.ingress.stop()
         if self.reporter is not None:
             # Final percentile sweep: an orderly shutdown publishes the tail
             # window instead of losing everything since the last 60 s tick.

@@ -54,11 +54,19 @@ class WalError(IOError):
     """Corrupt or inconsistent WAL content."""
 
 
-def walf(path: str) -> Tuple["WalWriter", "WalReader"]:
-    """Open (creating if needed) the log at ``path`` (wal.rs:38-50)."""
+def walf(
+    path: str, async_writes: Optional[bool] = None
+) -> Tuple["WalWriter", "WalReader"]:
+    """Open (creating if needed) the log at ``path`` (wal.rs:38-50).
+
+    ``async_writes=False`` forces synchronous appends (no drain thread) —
+    the deterministic simulators need it because a real thread's progress
+    is wall-clock state, and anything observing it (``pending()`` feeds
+    the ingress admission controller's ``wal_backlog`` signal) would leak
+    nondeterminism into a seeded virtual-time run."""
     fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
     size = os.fstat(fd).st_size
-    writer = WalWriter(fd, size, path)
+    writer = WalWriter(fd, size, path, async_writes=async_writes)
     reader = WalReader(path)
     reader._inflight = writer.inflight_get
     reader._writer_flush = writer.flush

@@ -41,7 +41,9 @@ from typing import Dict, List, Optional
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
-ARTIFACT_GLOBS = ("BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json")
+ARTIFACT_GLOBS = (
+    "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
+)
 
 # >10% below the best prior round fails the gate.
 DEFAULT_TOLERANCE = 0.10
@@ -132,6 +134,34 @@ def normalize(path: str) -> List[dict]:
                     round_, source, f"{prefix}{metric}", rec[key], "tx/s",
                     verifier=rec.get("verifier"), nodes=rec.get("nodes"),
                 ))
+
+    # OVERLOAD: per-multiplier committed-vs-offered rungs + the no-collapse
+    # ratios.  Its own family: the rungs measure degradation shape, and a
+    # ratio near 1.0 is the win — gating it against MAXLOAD peaks would
+    # compare different metrics.
+    if doc.get("metric") == "overload_committed_vs_offered":
+        for rung in doc.get("rungs") or []:
+            mult = rung.get("multiplier")
+            if mult is None or rung.get("committed_tx_s") is None:
+                continue
+            out.append(_record(
+                round_, source, f"{family}.committed_tx_s_{mult}x",
+                rung["committed_tx_s"], "tx/s",
+                offered=rung.get("offered_tx_s"), nodes=doc.get("nodes"),
+            ))
+        acceptance = doc.get("acceptance") or {}
+        for key in ("committed_3x_over_1x", "committed_5x_over_1x",
+                    "sim_committed_3x_over_1x"):
+            value = acceptance.get(key)
+            if value is None:
+                value = (doc.get("determinism") or {}).get(key)
+            if value is not None:
+                out.append(_record(round_, source, f"{family}.{key}", value,
+                                   "ratio"))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="overload artifact with no scored rungs")]
 
     # MAXLOAD_TAX: same-window A/B.
     if "tpu_over_cpu" in doc:
