@@ -121,6 +121,17 @@ class BlockStore:
         self._last_own_block: Optional[BlockReference] = None
         self._wal_reader = wal_reader
         self._metrics = metrics
+        # Equivocation detection (docs/adversary.md): per-authority count of
+        # EXTRA digests observed live at an (authority, round) the index
+        # already holds — the generalized form of the post-crash own-block
+        # double-proposal handling below.  Detection fires on LIVE inserts
+        # only (replay re-observes history already counted pre-crash) and
+        # once per distinct conflicting digest (the index key existing means
+        # this copy was already seen).  ``recorder`` (an optional
+        # FlightRecorder) gets the event edge; the counter is
+        # mysticeti_equivocation_detected_total{authority}.
+        self.recorder = None
+        self.equivocations_detected: Dict[AuthorityIndex, int] = {}
 
     # -- recovery (block_store.rs:50-116) --
 
@@ -237,13 +248,41 @@ class BlockStore:
         self, block: StatementBlock, position: WalPosition,
         proposed: bool = False,
     ) -> None:
+        equivocated = False
         with self._lock:
             self._highest_round = max(self._highest_round, block.round())
             self._add_own_index(block.reference, proposed)
             self._update_last_seen(block.reference)
-            self._index.setdefault(block.round(), {})[
-                (block.author(), block.digest())
-            ] = (position, block)
+            entries = self._index.setdefault(block.round(), {})
+            key = (block.author(), block.digest())
+            if key not in entries and any(
+                a == block.author() for (a, _) in entries
+            ):
+                # A SECOND distinct digest from this authority at this
+                # round: equivocation, observed the moment the conflicting
+                # copy lands in the DAG (valid signature and all — only
+                # the index can see a double proposal).
+                equivocated = True
+                author = block.author()
+                self.equivocations_detected[author] = (
+                    self.equivocations_detected.get(author, 0) + 1
+                )
+            entries[key] = (position, block)
+        if equivocated:
+            log.warning(
+                "equivocation detected: authority %d proposed a second "
+                "block at round %d", block.author(), block.round(),
+            )
+            if self._metrics is not None:
+                self._metrics.mysticeti_equivocation_detected_total.labels(
+                    str(block.author())
+                ).inc()
+            if self.recorder is not None:
+                self.recorder.record(
+                    "equivocation-detected",
+                    authority=block.author(),
+                    round=block.round(),
+                )
 
     def _add_unloaded(
         self, reference: BlockReference, position: WalPosition,

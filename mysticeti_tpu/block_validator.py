@@ -1442,13 +1442,20 @@ class BatchedSignatureVerifier(BlockVerifier):
             # The window EMA shares self._lock with the pending queue: the
             # read-modify-write must not interleave with _effective_delay_s
             # readers scheduling a flush from another flush's critical
-            # section.
-            with self._lock:
-                self._dispatch_ema_s = _update_ema(
-                    self._dispatch_ema_s,
-                    time.monotonic() - started,
-                    self.EMA_OUTLIER_S,
-                )
+            # section.  Under the simulator the EMA stays unseeded: it is a
+            # WALL-clock measurement, and _effective_delay_s arms a
+            # VIRTUAL-time flush timer from it — folding it in would make a
+            # seeded sim's flush schedule (and so its whole commit
+            # trajectory) depend on host load.  Sims run the fixed
+            # max_delay_s window instead (the arrival-gap term is loop-
+            # clocked and stays live).
+            if not is_simulated():
+                with self._lock:
+                    self._dispatch_ema_s = _update_ema(
+                        self._dispatch_ema_s,
+                        time.monotonic() - started,
+                        self.EMA_OUTLIER_S,
+                    )
             if tracer is not None:
                 t1 = tracer.now()
                 for block in sub_blocks:

@@ -45,6 +45,7 @@ import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from .adversary import AdversaryEngine, AdversarySpec
 from .block_handler import TestBlockHandler
 from .commit_observer import TestCommitObserver
 from .committee import Committee
@@ -56,7 +57,7 @@ from .metrics import Metrics
 from .net_sync import NetworkSyncer
 from .simulated_network import SimulatedNetwork
 from .tracing import logger
-from .types import BlockReference
+from .types import BlockReference, Share
 from .utils.tasks import spawn_logged
 
 log = logger(__name__)
@@ -202,12 +203,15 @@ class CrashFault:
 @dataclass
 class FaultPlan:
     """The whole declarative scenario; ``seed`` drives BOTH the simulator's
-    loop RNG and the engine's per-message fault draws."""
+    loop RNG and the engine's per-message fault draws.  ``adversaries``
+    (adversary.py) declares Byzantine behavior alongside the benign faults
+    — one plan, one seed, one byte-identical schedule."""
 
     seed: int = 0
     link_faults: List[LinkFault] = field(default_factory=list)
     partitions: List[PartitionFault] = field(default_factory=list)
     crashes: List[CrashFault] = field(default_factory=list)
+    adversaries: List[AdversarySpec] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -215,6 +219,7 @@ class FaultPlan:
             "link_faults": [f.to_dict() for f in self.link_faults],
             "partitions": [p.to_dict() for p in self.partitions],
             "crashes": [c.to_dict() for c in self.crashes],
+            "adversaries": [a.to_dict() for a in self.adversaries],
         }
 
     def to_json(self) -> str:
@@ -229,6 +234,9 @@ class FaultPlan:
                 PartitionFault.from_dict(p) for p in d.get("partitions", [])
             ],
             crashes=[CrashFault.from_dict(c) for c in d.get("crashes", [])],
+            adversaries=[
+                AdversarySpec.from_dict(a) for a in d.get("adversaries", [])
+            ],
         )
 
     @staticmethod
@@ -300,6 +308,34 @@ class SafetyChecker:
         # inside a node's accept pipeline is logged there, not propagated,
         # so the end-of-run audit must still fail the scenario.
         self._violation: Optional[SafetyViolation] = None
+        # Byzantine scenarios (adversary.py): declared adversaries are
+        # excluded from the HONEST consistency invariant — a node that
+        # actively lies forfeits its own-commit guarantees — and any
+        # divergence that involves one is recorded here, attributed by
+        # name, instead of failing the scenario.  Honest-honest divergence
+        # still raises: that is the safety property under attack.
+        self.adversaries: Set[int] = set()
+        self.adversary_divergence: List[dict] = []
+        # Committed-throughput accounting: transactions (Share statements)
+        # AND blocks in each node's committed sub-dags, keyed observer ->
+        # block author, counted once per height (a WAL-replay
+        # re-observation of an already-recorded height adds nothing).
+        # Per-author so the scenario matrix can compare HONEST-AUTHORED
+        # throughput against the clean twin — a Byzantine node's own
+        # unsequenced load is its own loss, not a liveness failure.
+        # Blocks are the liveness gate's unit: the sim's TestBlockHandler
+        # mints one Share per handle_blocks BATCH, so relayed/fetched
+        # delivery (which coalesces batches) under attack suppresses load
+        # GENERATION — a generator artifact the block count is blind to.
+        self.committed_tx: Dict[int, Dict[int, int]] = {}
+        self.committed_blocks: Dict[int, Dict[int, int]] = {}
+
+    def mark_adversary(self, authority: int) -> None:
+        self.adversaries.add(authority)
+
+    def _note_adversary_divergence(self, **fields) -> None:
+        self.adversary_divergence.append(dict(fields))
+        log.warning("adversary-attributed commit divergence: %s", fields)
 
     def note_adopted(
         self, authority: int, height: int, leader: Optional[BlockReference]
@@ -310,6 +346,13 @@ class SafetyChecker:
             mine = self._anchors.setdefault(authority, {})
             prev = mine.get(height)
             if prev is not None and prev != leader:
+                if authority in self.adversaries:
+                    self._note_adversary_divergence(
+                        kind="adopt-conflict", adversary=authority,
+                        height=height,
+                    )
+                    mine[height] = leader
+                    return
                 violation = SafetyViolation(
                     f"authority {authority} adopted anchor {leader!r} at "
                     f"height {height} but had committed {prev!r}"
@@ -323,8 +366,31 @@ class SafetyChecker:
         """Record a node's freshly committed sub-dags (List[CommittedSubDag])."""
         mine = self._anchors.setdefault(authority, {})
         for commit in committed:
+            if commit.height not in mine:
+                blocks = getattr(commit, "blocks", None) or ()
+                by_author = self.committed_tx.setdefault(authority, {})
+                blocks_by_author = self.committed_blocks.setdefault(
+                    authority, {}
+                )
+                for block in blocks:
+                    author = block.author()
+                    blocks_by_author[author] = (
+                        blocks_by_author.get(author, 0) + 1
+                    )
+                    shares = sum(
+                        1 for st in block.statements if isinstance(st, Share)
+                    )
+                    if shares:
+                        by_author[author] = by_author.get(author, 0) + shares
             prev = mine.get(commit.height)
             if prev is not None and prev != commit.anchor:
+                if authority in self.adversaries:
+                    self._note_adversary_divergence(
+                        kind="self-conflict", adversary=authority,
+                        height=commit.height,
+                    )
+                    mine[commit.height] = commit.anchor
+                    continue
                 violation = SafetyViolation(
                     f"authority {authority} committed two anchors at height "
                     f"{commit.height}: {prev!r} then {commit.anchor!r}"
@@ -358,11 +424,20 @@ class SafetyChecker:
         return out
 
     def check(self) -> None:
-        """Global prefix consistency: same anchor at every shared height."""
+        """Global prefix consistency: same anchor at every shared height.
+
+        With declared adversaries the invariant is audited over HONEST
+        nodes (that is the paper's guarantee: safety among the correct
+        f+1..n); an adversary node whose own commit stream diverges from
+        the honest golden sequence is recorded in
+        :attr:`adversary_divergence`, attributed by name — evidence, not a
+        scenario failure."""
         if self._violation is not None:
             raise self._violation
         golden: Dict[int, Tuple[BlockReference, int]] = {}
         for authority in sorted(self._anchors):
+            if authority in self.adversaries:
+                continue
             self.sequence(authority)  # per-node contiguity
             for height, anchor in self._anchors[authority].items():
                 prev = golden.get(height)
@@ -373,6 +448,19 @@ class SafetyChecker:
                         f"fork at height {height}: authority {prev[1]} "
                         f"committed {prev[0]!r}, authority {authority} "
                         f"committed {anchor!r}"
+                    )
+        for authority in sorted(self.adversaries & set(self._anchors)):
+            try:
+                self.sequence(authority)
+            except SafetyViolation:
+                self._note_adversary_divergence(
+                    kind="gap", adversary=authority
+                )
+            for height, anchor in self._anchors[authority].items():
+                prev = golden.get(height)
+                if prev is not None and prev[0] != anchor:
+                    self._note_adversary_divergence(
+                        kind="fork", adversary=authority, height=height,
                     )
 
 
@@ -432,12 +520,19 @@ class ChaosSimHarness:
         with_metrics: bool = False,
         slo: Optional[SLOThresholds] = None,
         health_interval_s: float = 1.0,
+        per_node_parameters: Optional[Dict[int, Parameters]] = None,
+        latency_ranges=None,
+        adversaries: Optional[Set[int]] = None,
     ) -> None:
         self.n = n
         self.wal_dir = wal_dir
         self.committee = committee or Committee.new_test([1] * n)
         self.signers = Committee.benchmark_signers(n)
         self.parameters = parameters or Parameters(leader_timeout_s=1.0)
+        # Mixed-version drills (scenarios.py): individual nodes may run
+        # with different Parameters (soft wire tags on/off, storage knobs)
+        # — exactly the rolling-upgrade skew a real fleet lives through.
+        self.per_node_parameters = per_node_parameters or {}
         # (authority, committee, metrics) -> BlockVerifier, or None for the
         # AcceptAll default (chaos scenarios that are not about the verifier
         # keep the sim fully single-threaded, hence bit-reproducible).
@@ -448,7 +543,9 @@ class ChaosSimHarness:
             Metrics() if with_metrics else None for _ in range(n)
         ]
         self.checker = SafetyChecker()
-        self.sim_net = SimulatedNetwork(n)
+        for adversary in sorted(adversaries or ()):
+            self.checker.mark_adversary(adversary)
+        self.sim_net = SimulatedNetwork(n, latency_ranges=latency_ranges)
         self.nodes: List[Optional[NetworkSyncer]] = [None] * n
         self.down: Set[int] = set()
         # Flight recorders: one ring per authority, SURVIVING restarts like
@@ -483,12 +580,16 @@ class ChaosSimHarness:
     def _wal_path(self, authority: int) -> str:
         return os.path.join(self.wal_dir, f"wal-{authority}")
 
+    def parameters_for(self, authority: int) -> Parameters:
+        return self.per_node_parameters.get(authority, self.parameters)
+
     def _build_node(self, authority: int) -> NetworkSyncer:
         from .storage import open_store
 
+        parameters = self.parameters_for(authority)
         recovered, observer_recovered, wal_writer, lifecycle = open_store(
             authority, self._wal_path(authority), self.committee,
-            self.parameters, self.metrics[authority],
+            parameters, self.metrics[authority],
         )
         handler = TestBlockHandler(
             last_transaction=authority * 1_000_000,
@@ -499,7 +600,7 @@ class ChaosSimHarness:
             block_handler=handler,
             authority=authority,
             committee=self.committee,
-            parameters=self.parameters,
+            parameters=parameters,
             recovered=recovered,
             wal_writer=wal_writer,
             options=CoreOptions.test(),
@@ -518,6 +619,10 @@ class ChaosSimHarness:
         observer.recorder = recorder
         if lifecycle is not None:
             lifecycle.recorder = recorder
+        # Equivocation detection (block_store.py) flows to the same ring:
+        # a double-proposal observed seconds before a safety incident is
+        # exactly the forensic edge the recorder exists for.
+        core.block_store.recorder = recorder
         verifier = (
             self.verifier_factory(
                 authority, self.committee, self.metrics[authority]
@@ -529,7 +634,7 @@ class ChaosSimHarness:
             core,
             observer,
             _SimNodeNetwork(self.sim_net.node_connections[authority]),
-            parameters=self.parameters,
+            parameters=parameters,
             block_verifier=verifier,
             metrics=self.metrics[authority],
             recorder=recorder,
@@ -609,7 +714,18 @@ class ChaosSimHarness:
         return self.checker.committed_height(authority)
 
     def sequences(self) -> Dict[int, List[BlockReference]]:
-        return {a: self.checker.sequence(a) for a in range(self.n)}
+        out: Dict[int, List[BlockReference]] = {}
+        for a in range(self.n):
+            try:
+                out[a] = self.checker.sequence(a)
+            except SafetyViolation:
+                if a not in self.checker.adversaries:
+                    raise
+                # An adversary's own gap is attributed evidence (already in
+                # adversary_divergence via check()), not a report failure.
+                out[a] = []
+        return out
+
 
 
 # ---------------------------------------------------------------------------
@@ -636,6 +752,17 @@ class ChaosEngine:
         self._blocked: Set[Tuple[int, int]] = set()
         self._log: List[dict] = []
         self._task: Optional[asyncio.Task] = None
+        # Byzantine layer (adversary.py): adversary nodes' outbound traffic
+        # is rewritten BEFORE the benign link faults, on its own plan-seeded
+        # RNG, so composing attacks with drops/partitions never shifts the
+        # benign draw sequence of an adversary-free plan.
+        self.adversary: Optional[AdversaryEngine] = (
+            AdversaryEngine(
+                plan.adversaries, harness.signers, harness.n, seed=plan.seed
+            )
+            if plan.adversaries
+            else None
+        )
 
     # -- lifecycle --
 
@@ -695,6 +822,20 @@ class ChaosEngine:
             self._record("blackhole", src=src, dst=dst, n=len(batch))
             return []
         t = asyncio.get_event_loop().time()
+        groups = (
+            self.adversary.transform(src, dst, batch, t)
+            if self.adversary is not None
+            else [(0.0, batch)]
+        )
+        out: List[tuple] = []
+        for base_delay, messages in groups:
+            for extra, sub in self._apply_link_faults(src, dst, messages, t):
+                out.append((base_delay + extra, sub))
+        return out
+
+    def _apply_link_faults(
+        self, src: int, dst: int, batch: list, t: float
+    ) -> List[tuple]:
         rule = next(
             (f for f in self.plan.link_faults if f.matches(src, dst, t)), None
         )
@@ -787,9 +928,93 @@ class ChaosReport:
     # reaches this report — the rings land on disk instead
     # (``flight-recorder-<authority>.json`` next to the WALs).
     recorder_dumps: Dict[int, bytes] = field(default_factory=dict)
+    # Byzantine layer (adversary.py): the injected attack schedule (ledger),
+    # what the honest fleet detected (per-node counter census), and any
+    # commit divergence attributed to a declared adversary.  All canonical
+    # — byte-identical across same-seed runs.
+    attack_log: List[dict] = field(default_factory=list)
+    attack_log_bytes: bytes = b""
+    attack_counts: Dict[str, int] = field(default_factory=dict)
+    detections: Dict[int, dict] = field(default_factory=dict)
+    adversary_divergence: List[dict] = field(default_factory=list)
+    # Committed Share statements / blocks, observer -> block author ->
+    # count (height-deduped): the scenario matrix's committed-throughput
+    # numerators, per-author so honest-authored load is separable.  The
+    # liveness gate uses BLOCKS (the protocol's own unit — Share counts
+    # also reflect the test generator's batch-shaped minting).
+    committed_tx: Dict[int, Dict[int, int]] = field(default_factory=dict)
+    committed_blocks: Dict[int, Dict[int, int]] = field(default_factory=dict)
+
+    @staticmethod
+    def _from_authors(
+        table: Dict[int, Dict[int, int]], authors: Set[int]
+    ) -> Dict[int, int]:
+        return {
+            observer: sum(
+                count
+                for author, count in by_author.items()
+                if author in authors
+            )
+            for observer, by_author in table.items()
+        }
+
+    def committed_tx_from(self, authors: Set[int]) -> Dict[int, int]:
+        """observer -> committed Shares authored by ``authors``."""
+        return self._from_authors(self.committed_tx, authors)
+
+    def committed_blocks_from(self, authors: Set[int]) -> Dict[int, int]:
+        """observer -> committed blocks authored by ``authors``."""
+        return self._from_authors(self.committed_blocks, authors)
 
     def schedule_digest(self) -> str:
         return hashlib.sha256(self.fault_log_bytes).hexdigest()
+
+    def attack_digest(self) -> str:
+        return hashlib.sha256(self.attack_log_bytes).hexdigest()
+
+    def detections_bytes(self) -> bytes:
+        return _canonical_json(
+            {str(a): d for a, d in sorted(self.detections.items())}
+        ).encode()
+
+
+def _labeled_counter_census(counter) -> Dict[str, float]:
+    """Non-zero label->value census of a prometheus counter.  Only the
+    ``_total`` samples enter (the ``_created`` companion carries a wall
+    timestamp and would break same-seed byte-identity)."""
+    out: Dict[str, float] = {}
+    for family in counter.collect():
+        for sample in family.samples:
+            if not sample.name.endswith("_total") or not sample.value:
+                continue
+            key = ",".join(
+                f"{k}={v}" for k, v in sorted(sample.labels.items())
+            ) or "_"
+            out[key] = sample.value
+    return {k: out[k] for k in sorted(out)}
+
+
+def collect_detections(harness: ChaosSimHarness) -> Dict[int, dict]:
+    """Per-node detection census: what each (metrics-carrying) node's
+    honest path caught and attributed.  The metrics objects survive
+    crash-restarts, so the census spans each node's whole life."""
+    detections: Dict[int, dict] = {}
+    for authority in range(harness.n):
+        metrics = harness.metrics[authority]
+        if metrics is None:
+            continue
+        node: dict = {}
+        for name, counter in (
+            ("equivocation", metrics.mysticeti_equivocation_detected_total),
+            ("invalid_blocks", metrics.mysticeti_invalid_blocks_total),
+            ("malformed", metrics.mysticeti_malformed_frames_total),
+        ):
+            census = _labeled_counter_census(counter)
+            if census:
+                node[name] = census
+        if node:
+            detections[authority] = node
+    return detections
 
 
 def run_chaos_sim(
@@ -802,6 +1027,9 @@ def run_chaos_sim(
     with_metrics: bool = False,
     extra_fault=None,
     slo: Optional[SLOThresholds] = None,
+    per_node_parameters: Optional[Dict[int, Parameters]] = None,
+    latency_ranges=None,
+    committee: Optional[Committee] = None,
 ) -> Tuple[ChaosReport, ChaosSimHarness]:
     """Run one chaos scenario to completion on a fresh DeterministicLoop.
 
@@ -813,13 +1041,35 @@ def run_chaos_sim(
     """
     from .runtime.simulated import run_simulation
 
+    if plan.adversaries:
+        if committee is None:
+            # Byzantine scenarios verify REAL signatures end-to-end: the
+            # default new_test committee shares one dummy key across all
+            # authorities, which would reject every honest block.  The
+            # benchmark committee's per-index keys match the harness
+            # signers.
+            committee = Committee.new_for_benchmarks(n)
+        if verifier_factory is None:
+            # An adversary plan with the AcceptAll default would make
+            # `invalid_sig` a silent no-op (tampered blocks accepted and
+            # committed, the detection counter never fires) — exactly what
+            # a CLI `chaos --plan` replay of a Byzantine plan would hit.
+            # Default to the sim re-sign oracle: exact Ed25519 semantics,
+            # deterministic, sim-priced.
+            from .scenarios import oracle_verifier_factory
+
+            verifier_factory = oracle_verifier_factory(n)
     harness = ChaosSimHarness(
         n,
         wal_dir,
         parameters=parameters,
+        committee=committee,
         verifier_factory=verifier_factory,
         with_metrics=with_metrics,
         slo=slo,
+        per_node_parameters=per_node_parameters,
+        latency_ranges=latency_ranges,
+        adversaries={spec.node for spec in plan.adversaries},
     )
     engine = ChaosEngine(harness, plan)
 
@@ -852,6 +1102,7 @@ def run_chaos_sim(
                 )
             raise
         monitor = harness.health_monitor
+        adversary = engine.adversary
         return ChaosReport(
             sequences=harness.sequences(),
             fault_log=engine.fault_log,
@@ -867,6 +1118,22 @@ def run_chaos_sim(
             recorder_dumps={
                 a: harness.recorders[a].snapshot_bytes()
                 for a in range(harness.n)
+            },
+            attack_log=adversary.ledger.entries if adversary else [],
+            attack_log_bytes=(
+                adversary.ledger.ledger_bytes() if adversary else b""
+            ),
+            attack_counts=adversary.ledger.counts() if adversary else {},
+            detections=collect_detections(harness),
+            adversary_divergence=list(harness.checker.adversary_divergence),
+            committed_tx={
+                observer: dict(by_author)
+                for observer, by_author in harness.checker.committed_tx.items()
+            },
+            committed_blocks={
+                observer: dict(by_author)
+                for observer, by_author in
+                harness.checker.committed_blocks.items()
             },
         )
 

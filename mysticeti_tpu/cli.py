@@ -356,6 +356,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     ov.add_argument("--report-out", default=None,
                     help="write the full report JSON here")
 
+    sc = sub.add_parser(
+        "scenarios",
+        help="declarative resilience scenario matrix: run one named "
+        "scenario (or the whole matrix) of composed Byzantine adversary "
+        "mixes + benign chaos + storage churn + geo latency + version "
+        "skew, each as an attacked run vs a same-seed clean twin "
+        "(docs/adversary.md)",
+    )
+    sc.add_argument("--list", action="store_true",
+                    help="list the matrix scenarios and exit")
+    sc.add_argument("--scenario", default=None,
+                    help="run only this named scenario (default: the whole "
+                    "matrix)")
+    sc.add_argument("--duration", type=float, default=None,
+                    help="override the scenario's virtual duration")
+    sc.add_argument("--seed", type=int, default=None,
+                    help="override the scenario's seed")
+    sc.add_argument("--working-directory", default=None,
+                    help="WAL root (default: a fresh temp dir, removed)")
+    sc.add_argument("--out", default=None,
+                    help="write the matrix verdict document as JSON")
+
     vs = sub.add_parser(
         "verifier-service",
         help="shared per-host verifier service: one warmed JAX runtime "
@@ -433,6 +455,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_chaos(args)
     if args.command == "overload":
         return run_overload(args)
+    if args.command == "scenarios":
+        return run_scenarios(args)
     if args.command == "verifier-service":
         from .verifier_service import run_service
 
@@ -497,6 +521,20 @@ def run_chaos(args) -> int:
     )
     print(f"faults injected: {faults or 'none'}")
     print(f"fault schedule digest: {report.schedule_digest()}")
+    if plan.adversaries:
+        attacks = ", ".join(
+            f"{key}={count}"
+            for key, count in sorted(report.attack_counts.items())
+        )
+        print(f"attacks injected: {attacks or 'none'}")
+        print(f"attack ledger digest: {report.attack_digest()}")
+        for authority, census in sorted(report.detections.items()):
+            for surface, labels in sorted(census.items()):
+                tally = ", ".join(
+                    f"{label}={int(count)}"
+                    for label, count in sorted(labels.items())
+                )
+                print(f"detected by A{authority} [{surface}]: {tally}")
     for alert in report.slo_alerts:
         who = "node" if alert["authority"] is None else f"A{alert['authority']}"
         print(
@@ -521,6 +559,53 @@ def run_chaos(args) -> int:
         print(f"health timeline written to {args.health_out}")
     print("safety: OK (identical committed prefixes on all nodes)")
     return 0
+
+
+def run_scenarios(args) -> int:
+    """The `scenarios` subcommand: the resilience matrix (scenarios.py).
+    Each scenario prints its verdict line; the exit code is 0 only when
+    every scenario run passed (safety + detection + throughput ratio)."""
+    import dataclasses
+    import json
+
+    from .scenarios import default_matrix, run_matrix, scenario_by_name
+
+    if args.list:
+        for scenario in default_matrix():
+            print(f"{scenario.name:<24} n={scenario.nodes:<3} "
+                  f"{scenario.duration_s:>5.0f}s  {scenario.description}")
+        return 0
+    if args.scenario:
+        selected = [scenario_by_name(args.scenario)]
+    else:
+        selected = default_matrix()
+    overrides = {}
+    if args.duration is not None:
+        overrides["duration_s"] = args.duration
+    if args.seed is not None:
+        overrides["seed"] = args.seed
+    if overrides:
+        selected = [dataclasses.replace(s, **overrides) for s in selected]
+    doc = run_matrix(selected, wal_root=args.working_directory)
+    for verdict in doc["scenarios"]:
+        name = verdict["scenario"]["name"]
+        status = "PASS" if verdict["passed"] else "FAIL"
+        detections = verdict.get("detections", {})
+        print(
+            f"{name:<24} {status}  ratio={verdict.get('throughput_ratio', 0.0):.2f} "
+            f"committed={verdict.get('committed_tx', 0)} "
+            f"attacks={sum(verdict.get('attack_counts', {}).values())} "
+            f"detected={sum(1 for d in detections.values() if d['ok'])}"
+            f"/{len(detections)}"
+            + ("" if verdict["safety_ok"] else "  SAFETY-VIOLATION")
+        )
+    print(f"matrix: {doc['passed']} passed, {doc['failed']} failed")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"matrix verdicts written to {args.out}")
+    return 0 if doc["all_pass"] else 1
 
 
 def run_overload(args) -> int:

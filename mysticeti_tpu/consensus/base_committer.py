@@ -111,6 +111,18 @@ class BaseCommitter:
         for reference in potential_certificate.includes:
             vote = all_votes.get(reference)
             if vote is None:
+                if reference.round <= leader_block.round():
+                    # Cannot vote for the leader (includes point strictly
+                    # down-round, so no path from here reaches a block that
+                    # links the leader).  Also the reference may simply not
+                    # be stored: a snapshot-rejoiner's first proposal
+                    # carries its pre-crash pending includes — settled
+                    # history the rest of the fleet long GC'd (the
+                    # BlockManager admits such blocks by treating sub-floor
+                    # includes as satisfied; this walk must tolerate the
+                    # same shape instead of asserting).
+                    all_votes[reference] = False
+                    continue
                 block = self.block_store.get_block(reference)
                 assert block is not None, "whole sub-dag must be stored by now"
                 vote = self.is_vote(block, leader_block)

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Deque, List, Optional, Sequence, Set, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import spans
 from .block_manager import BlockManager
@@ -149,6 +149,15 @@ class Core:
         self.epoch_manager = EpochManager()
         self.rounds_in_epoch = parameters.rounds_in_epoch
         self.store_retain_rounds = parameters.store_retain_rounds
+        self.leader_liveness_horizon = parameters.leader_liveness_horizon_rounds
+        # Authorities the sync layer scored content-silent (live connection,
+        # own blocks only ever recovered via relays/fetch — the withholder
+        # shape).  Maintained by NetworkSyncer._score_missing; membership
+        # checks only, so plain-set mutation from the net loop is safe.
+        self.content_silent: Set[AuthorityIndex] = set()
+        # leader -> last leader_round whose liveness skip was counted (the
+        # metric counts skipped SLOTS, not readiness polls).
+        self._leader_skip_marked: Dict[AuthorityIndex, RoundNumber] = {}
         self.storage = storage
         self.committer: UniversalCommitter = (
             UniversalCommitterBuilder(committee, block_store, metrics)
@@ -343,6 +352,36 @@ class Core:
         connected_leaders = [
             l for l in leaders if connected_authorities.contains(l)
         ]
+        if self.leader_liveness_horizon > 0:
+            # Leader liveness scoring (docs/adversary.md): a leader whose
+            # blocks have not been ACCEPTED locally for more than the
+            # horizon is not worth gating the proposal on — a Byzantine
+            # authority that signs invalidly (or withholds from us) would
+            # otherwise tax every one of its slots with a full leader
+            # timeout.  The timeout task stays as the universal backstop,
+            # and the commit rule is untouched: the slot is still decided
+            # (skip) by 2f+1 non-links, exactly as on a timeout.  An
+            # authority that resumes producing acceptable blocks re-enters
+            # the wait set as soon as its last-seen round catches back up.
+            live = []
+            for leader in connected_leaders:
+                seen = self.block_store.last_seen_by_authority(leader)
+                lagging = leader_round - seen > self.leader_liveness_horizon
+                if lagging or leader in self.content_silent:
+                    # Once per (leader, round): readiness is polled on
+                    # every dispatcher event, so a bare inc() here would
+                    # count polls (thousands per skipped slot), not skips.
+                    if (
+                        self.metrics is not None
+                        and self._leader_skip_marked.get(leader) != leader_round
+                    ):
+                        self._leader_skip_marked[leader] = leader_round
+                        self.metrics.mysticeti_leader_wait_skipped_total.labels(
+                            str(leader)
+                        ).inc()
+                else:
+                    live.append(leader)
+            connected_leaders = live
         if not connected_leaders:
             return True
         return self.block_store.all_blocks_exists_at_authority_round(

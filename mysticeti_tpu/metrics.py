@@ -508,6 +508,40 @@ class Metrics:
             "faults injected by the deterministic chaos engine",
             labels=("kind",),
         )
+        # Byzantine adversary plane (adversary.py + docs/adversary.md):
+        # what the honest path detected and to whom it attributes it.
+        self.mysticeti_equivocation_detected_total = counter(
+            "mysticeti_equivocation_detected_total",
+            "distinct conflicting blocks observed at one (authority, round) "
+            "in the DAG index — a double proposal, attributed to the "
+            "equivocating authority (includes the benign post-torn-tail "
+            "self-equivocation; each extra digest counts once)",
+            labels=("authority",),
+        )
+        self.mysticeti_invalid_blocks_total = counter(
+            "mysticeti_invalid_blocks_total",
+            "blocks rejected on the receive path, attributed by authority "
+            "and reason: signature (verifier rejected the Ed25519 check), "
+            "structure (consensus-rule check failed; attributed to the "
+            "claimed author), malformed (undecodable block bytes; "
+            "attributed to the DELIVERING peer)",
+            labels=("authority", "reason"),
+        )
+        self.mysticeti_malformed_frames_total = counter(
+            "mysticeti_malformed_frames_total",
+            "malformed mesh frames (garbage length prefix, oversized "
+            "frame, undecodable payload) that severed the delivering "
+            "connection, by peer",
+            labels=("peer",),
+        )
+        self.mysticeti_leader_wait_skipped_total = counter(
+            "mysticeti_leader_wait_skipped_total",
+            "proposal-gating waits skipped because the round's leader had "
+            "not produced a locally-accepted block within the liveness "
+            "horizon (crashed, withholding, or signing invalidly), by the "
+            "leader waited-for",
+            labels=("authority",),
+        )
 
         # Utilization timers (metrics.rs:615-666).
         self.utilization_timer_us = counter(

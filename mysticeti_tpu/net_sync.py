@@ -162,6 +162,14 @@ class NetworkSyncer:
         # disseminate_others_blocks knob): which connected peers relay which
         # unreachable authority's blocks for us, within the config caps.
         self._helper_subs = HelperSubscriptions(self.parameters.synchronizer)
+        # Content-silence scoring (docs/adversary.md): consecutive missing-
+        # parent fetches per author with no intervening DIRECT delivery of
+        # that author's own blocks.  A live connection that never delivers
+        # its own proposals (a withholder, or a grey-failed sender) looks
+        # exactly like this; past the threshold we arm relay streams for it
+        # as if its connection had dropped — the fetch path stops taxing
+        # the quorum path one round-trip per round.
+        self._fetch_gap_by_author: Dict[int, int] = {}
         self._stopped = asyncio.Event()
         self._wal_sync_thread: Optional[threading.Thread] = None
         self._start_wal_sync_thread = start_wal_sync_thread
@@ -378,7 +386,7 @@ class NetworkSyncer:
                                 ).observe(max(0.0, raw_s))
                             transit = (peer, raw_s, rtt_s)
                     verified = await self._decode_fresh(
-                        msg.blocks, transit=transit
+                        msg.blocks, transit=transit, peer=peer
                     )
                     verified = [
                         b for b in verified
@@ -589,26 +597,46 @@ class NetworkSyncer:
     # Nothing in this pipeline may hop to the owner per block; a regression
     # here multiplies the owner queue by the frame size at saturation.
 
+    def _count_invalid(self, authority, reason: str, count: int = 1) -> None:
+        """Invalid-block attribution (docs/adversary.md): a rejection used
+        to vanish into a log line — now every one lands on
+        ``mysticeti_invalid_blocks_total{authority, reason}`` and in the
+        flight-recorder ring, so a misbehaving peer is attributable from
+        /health and fleetmon."""
+        if self.metrics is not None:
+            self.metrics.mysticeti_invalid_blocks_total.labels(
+                str(authority), reason
+            ).inc(count)
+        self._record(
+            "invalid-block", authority=authority, reason=reason, count=count
+        )
+
     async def _decode_fresh(
-        self, serialized_blocks, transit=None
+        self, serialized_blocks, transit=None, peer=None
     ) -> List[StatementBlock]:
         """Stage 1 (host, fast): parse, dedup via the core task, consensus-
         rule checks.  ``transit`` is ``(src peer, raw signed transit s,
         rtt s or None)`` when the frame rode the timestamp extension — each
         fresh block then gets a ``transit`` span whose args carry the link
-        and the raw value for the fleet merger's skew estimator."""
+        and the raw value for the fleet merger's skew estimator.  ``peer``
+        attributes malformed payloads (undecodable bytes name no author —
+        the DELIVERING connection is the misbehaving party)."""
         tracer = spans.active()
         t_recv = tracer.now() if tracer is not None else 0.0
         timer = self._utilization_timer
         blocks: List[StatementBlock] = []
+        malformed = 0
         with timer("net:decode"):
             for raw in serialized_blocks:
                 try:
                     block = StatementBlock.from_bytes(raw)
                 except Exception:
                     log.warning("dropping malformed block bytes from peer")
+                    malformed += 1
                     continue  # malformed: drop (byzantine peer)
                 blocks.append(block)
+        if malformed and peer is not None:
+            self._count_invalid(peer, "malformed", malformed)
         if not blocks:
             return []
         # Dedup through the core task before paying for verification.
@@ -621,6 +649,7 @@ class NetworkSyncer:
                     block.verify_structure(self.core.committee)
                 except VerificationError as exc:
                     log.warning("rejecting block %r: %s", block.reference, exc)
+                    self._count_invalid(block.author(), "structure")
                     continue
                 verified.append(block)
         if self.metrics is not None and verified:
@@ -676,6 +705,17 @@ class NetworkSyncer:
                 len(verified) - len(accepted),
                 len(verified),
             )
+            rejected_by_author: Dict[int, int] = {}
+            for block, ok in zip(verified, results):
+                if not ok:
+                    author = block.author()
+                    rejected_by_author[author] = (
+                        rejected_by_author.get(author, 0) + 1
+                    )
+            for author in sorted(rejected_by_author):
+                self._count_invalid(
+                    author, "signature", rejected_by_author[author]
+                )
         return accepted
 
     async def _add_accepted(self, accepted: List[StatementBlock], origin) -> None:
@@ -694,6 +734,29 @@ class NetworkSyncer:
         missing = await self.dispatcher.add_blocks(
             accepted, self.connected_authorities.copy()
         )
+        if accepted and any(
+            d.relay_serving for d in self._disseminators.values()
+        ):
+            # Freshly stored peer blocks must reach our relay subscribers
+            # NOW — their next chance is our own next proposal, a round too
+            # late for a parked child.  No-op when nothing was ever relayed
+            # (the production-default clean path), and gated on the batch
+            # actually carrying a RELAYED author — waking every stream per
+            # honest batch is a quadratic wake storm under attack.
+            served = set()
+            for d in self._disseminators.values():
+                if d.relay_serving:
+                    served.update(d.relayed_authorities())
+            if any(block.author() in served for block in accepted):
+                self.signals.new_block_ready()
+        if origin is not None and self._fetch_gap_by_author:
+            # A direct own-block delivery clears the author's silence score
+            # (an honest-but-jittery peer must never accumulate one).
+            for block in accepted:
+                if block.author() == origin.peer:
+                    self._fetch_gap_by_author.pop(origin.peer, None)
+                    self.core.content_silent.discard(origin.peer)
+                    break
         if self.metrics is not None:
             from .runtime import timestamp_utc
 
@@ -705,6 +768,8 @@ class NetworkSyncer:
                         str(block.author())
                     ).observe(max(0.0, now - created / 1e9))
         if missing:
+            if self.parameters.synchronizer.disseminate_others_blocks:
+                self._score_missing(missing, origin)
             # Request missing causal history from the connection that
             # delivered the children — it is the peer most likely to have the
             # parents (net_sync.rs:276,388-399).  If that connection is stale
@@ -718,6 +783,78 @@ class NetworkSyncer:
                 for peer, conn in list(self.connections.items()):
                     if conn.try_send(request):
                         break
+
+    # Missing-parent fetches tolerated for one author (with a LIVE direct
+    # connection and no direct own-block delivery in between) before its
+    # relay streams arm: low enough that a withholder costs a handful of
+    # rounds, high enough that ordinary delivery jitter never trips it.
+    CONTENT_SILENCE_FETCHES = 5
+
+    def _score_missing(self, missing, origin) -> None:
+        """Adversary-shaped gap scoring on the fetch path (two shapes):
+
+        * **equivocation-shaped** — the store already holds a DIFFERENT
+          digest at the missing reference's (authority, round): some peer
+          included a sibling we were never sent.  One relay subscription
+          makes every future variant arrive proactively instead of one
+          pull round-trip per round.
+        * **content silence** — repeated gaps for an author whose direct
+          connection is alive but never delivers its own blocks (the
+          withholder).  Past :data:`CONTENT_SILENCE_FETCHES`, arm relays
+          exactly as if the connection had dropped.
+
+        The relay is asked of ``origin`` first — the peer whose blocks
+        referenced the missing digest PROVABLY stores it (an equivocation
+        variant lives only on the subset the adversary favored with it;
+        a blind helper pick would relay the copy we already hold)."""
+        store = self.core.block_store
+        for ref in missing:
+            author = ref.authority
+            if author == self.core.authority:
+                continue
+            if store.block_exists_at_authority_round(author, ref.round):
+                self._record(
+                    "equivocation-gap", authority=author, round=ref.round
+                )
+                self._ask_relay_of(author, origin)
+                continue
+            score = self._fetch_gap_by_author.get(author, 0) + 1
+            self._fetch_gap_by_author[author] = score
+            # >= with the content_silent set as the armed flag: an `==`
+            # one-shot would disarm FOREVER if the connection happened to
+            # be mid-reconnect at the exact threshold fetch.
+            if (
+                score >= self.CONTENT_SILENCE_FETCHES
+                and author not in self.core.content_silent
+            ):
+                conn = self.connections.get(author)
+                if conn is not None and not conn.is_closed():
+                    self._record("content-silent", authority=author)
+                    # Stop gating proposals on this author's leader slots
+                    # too (core.ready_new_block): its blocks now arrive via
+                    # relays — waiting for the relay hop on every one of
+                    # its slots is the withholder's remaining tax.
+                    self.core.content_silent.add(author)
+                    self._ask_relay_of(author, origin)
+
+    def _ask_relay_of(self, authority: int, origin) -> None:
+        """Subscribe to ``origin``'s relay of ``authority``'s blocks
+        (falling back to the blind helper pick when the origin is gone),
+        within the same per-authority/total caps as drop-triggered asks."""
+        if (
+            origin is not None
+            and origin.peer != authority
+            and self.connections.get(origin.peer) is origin
+            and self._helper_subs.may_ask(authority, origin.peer)
+        ):
+            last_seen = self.core.block_store.last_seen_by_authority(authority)
+            if origin.try_send(SubscribeOthersFrom(authority, last_seen)):
+                self._helper_subs.note_asked(authority, origin.peer)
+                self._record(
+                    "helper-ask", authority=authority, helper=origin.peer
+                )
+                return
+        self._ask_relays_for(authority)
 
     # -- background tasks --
 

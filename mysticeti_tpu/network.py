@@ -946,15 +946,49 @@ class TcpNetwork:
             sent_bytes = metrics.mesh_wire_bytes_total.labels("sent")
             coalesced = metrics.mesh_frames_coalesced_total
 
+        def _count_malformed() -> None:
+            if metrics is not None:
+                metrics.mysticeti_malformed_frames_total.labels(
+                    str(peer)
+                ).inc()
+
         async def read_loop():
             while True:
-                if receiver is not None:
-                    frame = await receiver.read_frame()
-                else:
-                    frame = await _read_frame(reader)
+                try:
+                    if receiver is not None:
+                        frame = await receiver.read_frame()
+                    else:
+                        frame = await _read_frame(reader)
+                except SerdeError as exc:
+                    # Garbage or oversized length prefix: the stream is
+                    # desynced beyond recovery — sever THIS connection
+                    # (counted, attributed) and let the reconnect worker
+                    # start clean.  That is the cap on malformed-frame
+                    # handling: one bad frame, one severed connection,
+                    # never an uncaught decode error.
+                    log.warning(
+                        "malformed frame from authority %d (%s): severing "
+                        "connection", peer, exc,
+                    )
+                    _count_malformed()
+                    return
                 if recv_bytes is not None:
                     recv_bytes.inc(len(frame) + 4)
-                msg = decode_message(frame)
+                try:
+                    msg = decode_message(frame)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 - byzantine payload
+                    # Undecodable payload inside a well-framed length: same
+                    # verdict as a garbage prefix.  Catching broadly is the
+                    # contract — no struct/decode error may escape the
+                    # protocol callback path.
+                    log.warning(
+                        "undecodable frame payload from authority %d (%r): "
+                        "severing connection", peer, exc,
+                    )
+                    _count_malformed()
+                    return
                 if isinstance(msg, Ping):
                     # Priority lane: the echo must not queue behind bulk
                     # frames or the peer's RTT estimate absorbs our send

@@ -32,8 +32,13 @@ log = logger(__name__)
 class SimulatedNetwork:
     LATENCY_RANGE = (0.050, 0.100)  # one-way seconds (simulated_network.rs:20)
 
-    def __init__(self, num_authorities: int) -> None:
+    def __init__(self, num_authorities: int, latency_ranges=None) -> None:
         self.n = num_authorities
+        # Geo-latency profile (scenario matrix): optional per-directed-link
+        # (src, dst) -> (lo, hi) one-way latency ranges; links not named
+        # fall back to LATENCY_RANGE.  Draws still come from the loop RNG
+        # in delivery order, so a profiled sim stays seed-reproducible.
+        self.latency_ranges = latency_ranges or {}
         # per-node queue of fresh connections (what TcpNetwork.connections is).
         self.node_connections: List[asyncio.Queue] = [
             asyncio.Queue() for _ in range(num_authorities)
@@ -61,10 +66,10 @@ class SimulatedNetwork:
         await self.node_connections[a].put(ca)
         await self.node_connections[b].put(cb)
 
-    def _latency(self) -> float:
+    def _latency(self, src: int = -1, dst: int = -1) -> float:
         loop = asyncio.get_event_loop()
         rng = getattr(loop, "rng", None)
-        lo, hi = self.LATENCY_RANGE
+        lo, hi = self.latency_ranges.get((src, dst), self.LATENCY_RANGE)
         if rng is None:
             import random
 
@@ -97,7 +102,7 @@ class SimulatedNetwork:
             )
             if not groups:
                 continue
-            base_latency = self._latency()
+            base_latency = self._latency(src, dst)
             for extra_delay, messages in groups:
                 if not messages:
                     continue

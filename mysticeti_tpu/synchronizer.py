@@ -149,6 +149,12 @@ class BlockDisseminator:
         # traffic from the own-block stream.
         self._helper_tasks: Dict[int, asyncio.Task] = {}
         self.helper_blocks_sent = 0
+        # True once any relay stream was requested on this connection: the
+        # receive path then wakes the streams on freshly STORED peer blocks
+        # (block_ready otherwise fires only on own proposals, which would
+        # delay every relayed block by up to a round — always just behind
+        # the children that reference it).
+        self.relay_serving = False
         # Snapshot catch-up stream (storage.py): one-shot push of the whole
         # retained block window to a far-behind peer that adopted our
         # manifest; counters feed the catch-up artifact/telemetry.
@@ -190,6 +196,7 @@ class BlockDisseminator:
         existing = self._helper_tasks.pop(authority, None)
         if existing is not None:
             existing.cancel()
+        self.relay_serving = True
         live = sum(1 for t in self._helper_tasks.values() if not t.done())
         if live >= self.parameters.absolute_maximum_helpers:
             log.warning(
@@ -252,6 +259,16 @@ class BlockDisseminator:
         if key is not None:
             cache.put(key, entry)
         return entry
+
+    def relayed_authorities(self) -> List[int]:
+        """Authorities with a LIVE relay stream on this connection (the
+        receive path wakes streams only for batches carrying their
+        blocks)."""
+        return [
+            authority
+            for authority, task in self._helper_tasks.items()
+            if not task.done()
+        ]
 
     async def _stream_others(
         self, authority: int, from_round: RoundNumber

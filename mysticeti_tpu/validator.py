@@ -288,6 +288,8 @@ class Validator:
             os.environ.get("TRANSACTION_SIZE", str(transaction_size))
         )
         recorder = v._make_recorder(authority, lifecycle, observer)
+        # Equivocation detection events (block_store.py) ride the ring too.
+        core.block_store.recorder = recorder
         block_verifier = _make_verifier(verifier, committee, v.metrics)
         # Overload modes (tools/overload_bench.py drives these through the
         # environment): an offered-load multiplier schedule and a closed
@@ -409,6 +411,7 @@ class Validator:
                 max_latency_s=parameters.network_connection_max_latency_s,
             )
         recorder = v._make_recorder(authority, lifecycle, observer)
+        core.block_store.recorder = recorder
         block_verifier = _make_verifier(verifier, committee, v.metrics)
         v.network_syncer = NetworkSyncer(
             core,

@@ -183,3 +183,58 @@ def test_repo_artifacts_yield_nonempty_trajectory(capsys):
     rounds = int(header.split("record(s),")[1].split("metric(s),")[1]
                  .split("round(s)")[0].strip())
     assert rounds >= 5, header
+
+
+# -- SCENARIO family (the resilience matrix) ---------------------------------
+
+
+def _scenario_doc(passed_map, determinism=True):
+    return {
+        "metric": "scenario_matrix",
+        "scenarios": [
+            {
+                "scenario": {"name": name, "min_ratio": 0.8},
+                "passed": ok,
+                "throughput_ratio": 0.84 if ok else 0.41,
+                "safety_ok": True,
+            }
+            for name, ok in passed_map.items()
+        ],
+        "determinism": (
+            {"scenario": "byzantine-at-f", "byte_identical": True}
+            if determinism
+            else {}
+        ),
+    }
+
+
+def test_normalize_scenario_matrix(tmp_path):
+    records = normalize(_write(tmp_path, "SCENARIO_r12.json", _scenario_doc(
+        {"byzantine-at-f": True, "wan-geo-profile": True}
+    )))
+    by_metric = {r["metric"]: r for r in records}
+    row = by_metric["SCENARIO.byzantine-at-f.passed"]
+    assert row["value"] == 1.0 and row["unit"] == "pass"
+    assert row["ratio"] == 0.84 and row["min_ratio"] == 0.8
+    assert by_metric["SCENARIO.determinism_byte_identical"]["value"] == 1.0
+    # A failing scenario scores 0.0 — a pass->fail flip between rounds is
+    # a 100% drop, exactly what the generic gate fires on.
+    failed = normalize(_write(tmp_path, "SCENARIO_r13.json", _scenario_doc(
+        {"byzantine-at-f": False}, determinism=False
+    )))
+    assert failed[0]["metric"] == "SCENARIO.byzantine-at-f.passed"
+    assert failed[0]["value"] == 0.0
+
+
+def test_scenario_pass_fail_flip_gates(tmp_path, capsys):
+    _write(tmp_path, "SCENARIO_r12.json",
+           _scenario_doc({"byzantine-at-f": True}, determinism=False))
+    assert main(["--repo", str(tmp_path)]) == 0
+    capsys.readouterr()
+    _write(tmp_path, "SCENARIO_r13.json",
+           _scenario_doc({"byzantine-at-f": False}, determinism=False))
+    assert main(["--repo", str(tmp_path)]) == 2
+    out = capsys.readouterr().out
+    assert "SCENARIO.byzantine-at-f.passed" in out
+    # Ratio noise between PASSING rounds never gates: the scored value is
+    # the verdict, the ratio only context.

@@ -43,6 +43,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 ARTIFACT_GLOBS = (
     "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
+    "SCENARIO_*.json",
 )
 
 # >10% below the best prior round fails the gate.
@@ -162,6 +163,35 @@ def normalize(path: str) -> List[dict]:
             return out
         return [_record(round_, source, "unparsed", None, "",
                         note="overload artifact with no scored rungs")]
+
+    # SCENARIO: the resilience matrix (tools/scenario_matrix.py).  One
+    # verdict row per scenario; the SCORED value is pass (1.0) / fail
+    # (0.0), so the generic gate fires exactly when a scenario FLIPS from
+    # pass to fail (a 100% drop) and never on throughput-ratio noise
+    # between passing rounds — the ratio rides along as context.
+    if doc.get("metric") == "scenario_matrix":
+        for verdict in doc.get("scenarios") or []:
+            scenario = (verdict.get("scenario") or {}).get("name")
+            if not scenario:
+                continue
+            out.append(_record(
+                round_, source, f"{family}.{scenario}.passed",
+                1.0 if verdict.get("passed") else 0.0, "pass",
+                ratio=verdict.get("throughput_ratio"),
+                min_ratio=(verdict.get("scenario") or {}).get("min_ratio"),
+                safety_ok=verdict.get("safety_ok"),
+            ))
+        determinism = doc.get("determinism") or {}
+        if determinism.get("byte_identical") is not None:
+            out.append(_record(
+                round_, source, f"{family}.determinism_byte_identical",
+                1.0 if determinism["byte_identical"] else 0.0, "pass",
+                scenario=determinism.get("scenario"),
+            ))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="scenario artifact with no verdicts")]
 
     # MAXLOAD_TAX: same-window A/B.
     if "tpu_over_cpu" in doc:
