@@ -123,6 +123,14 @@ GUARDED_FIELDS: Dict[str, str] = {
     # the loop — an unguarded spend would let two concurrent admits both
     # read the same balance and double the admitted rate.
     "_tokens": "_lock",
+    # Subsystem accountant (profiling.SubsystemAccountant): the sampler
+    # thread ingests the census while publish()/report() read from the
+    # loop or a shutdown path — every counter mutation must hold the
+    # accountant lock or a publish() mid-ingest exports a torn delta.
+    "_cpu_seconds": "_acct_lock",
+    "_census_ticks": "_acct_lock",
+    "_convoy_ticks": "_acct_lock",
+    "_runnable_sum": "_acct_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

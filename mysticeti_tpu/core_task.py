@@ -51,6 +51,11 @@ class CoreTaskDispatcher:
         # default terminates the process (the reference's panic posture);
         # tests inject a recorder.
         self.fatal_handler = fatal_handler or self._default_fatal
+        # Host attribution plane (hostattr.py): when a HostMonitor is
+        # attached, every synchronous command's wall duration is reported
+        # to its blocking-call detector — the dynamic twin of the
+        # async-blocking lint rule.
+        self.blocking_monitor = None
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=CORE_QUEUE_SIZE)
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
@@ -95,17 +100,35 @@ class CoreTaskDispatcher:
         consecutive_failures = 0
         failed_kinds: Set[str] = set()
         dequeued = self.metrics.core_lock_dequeued if self.metrics else None
+        # Wall-clock measurement is a host observation: under the
+        # virtual-time loop it would read real elapsed time against
+        # simulated schedules, so the detector stays off there (evaluated
+        # once — the loop flavor cannot change mid-run).
+        from .runtime import is_simulated
+        from time import perf_counter
+
+        measure_blocking = not is_simulated()
         while True:
             command, args, reply, internal = await self._queue.get()
             if dequeued is not None:
                 dequeued.inc()
             try:
+                label = getattr(command, "__name__", "other")
+                monitor = self.blocking_monitor
+                t0 = (
+                    perf_counter()
+                    if monitor is not None and measure_blocking
+                    else 0.0
+                )
                 if timers is not None:
-                    label = getattr(command, "__name__", "other")
                     with timers(f"core:{label}"):
                         result = command(*args)
                 else:
                     result = command(*args)
+                if monitor is not None and measure_blocking:
+                    monitor.note_command(
+                        f"core:{label}", perf_counter() - t0
+                    )
                 consecutive_failures = 0
                 failed_kinds.clear()
                 if reply is not None and not reply.done():

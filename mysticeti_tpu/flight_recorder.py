@@ -9,7 +9,9 @@ module is the bounded black box that does:
 * :class:`FlightRecorder` — a fixed-capacity in-memory ring of structured
   events, one per node, recorded from the consensus hot paths at edge
   granularity (block lifecycle edges, breaker/pin transitions, SLO alerts,
-  GC/checkpoint actions, sync decisions, connection churn — never per
+  GC/checkpoint actions, sync decisions, connection churn, and the host
+  attribution plane's ``blocking-call`` detections — hostattr.py flags a
+  synchronous hold of the core owner past the threshold — never per
   message).  The ring is lock-disciplined (``_ring_lock``; the lint's
   GUARDED_FIELDS covers the ring field) because dumps may be requested from
   the metrics endpoint or a signal path while the loop records.

@@ -58,6 +58,10 @@ ALERT_STAGES = {
     "authority-lag": "receive",
     "breaker-open": "verify",
     "low-participation": "receive",
+    # Host attribution plane (hostattr.py): a laggy or blocked event loop
+    # starves block ingestion first, so both kinds indict the dag_add edge.
+    "loop-lag": "dag_add",
+    "blocking-call": "dag_add",
 }
 
 # Snapshot keys whose values depend on real-thread timing (the WAL drain
@@ -82,6 +86,9 @@ class SLOThresholds:
     # Cluster-level: fraction of authorities that must be participating
     # (frontier lag within max_authority_lag_rounds).
     min_participation: float = 0.0
+    # Host attribution plane (hostattr.py): event-loop responsiveness SLOs.
+    max_loop_lag_s: float = 0.0  # loop-lag p99 ceiling
+    max_blocking_call_ms: float = 0.0  # worst synchronous core-owner hold
 
     def to_dict(self) -> dict:
         return {
@@ -91,6 +98,8 @@ class SLOThresholds:
             "max_authority_lag_rounds": self.max_authority_lag_rounds,
             "max_breaker_open_fraction": self.max_breaker_open_fraction,
             "min_participation": self.min_participation,
+            "max_loop_lag_s": self.max_loop_lag_s,
+            "max_blocking_call_ms": self.max_blocking_call_ms,
         }
 
     @staticmethod
@@ -104,6 +113,8 @@ class SLOThresholds:
                 d.get("max_breaker_open_fraction", 0.0)
             ),
             min_participation=float(d.get("min_participation", 0.0)),
+            max_loop_lag_s=float(d.get("max_loop_lag_s", 0.0)),
+            max_blocking_call_ms=float(d.get("max_blocking_call_ms", 0.0)),
         )
 
 
@@ -257,6 +268,7 @@ class HealthProbe:
         self._block_verifier = None
         self._commit_observer = None
         self._ingress = None
+        self._host_monitor = None
         self._task: Optional[asyncio.Task] = None
         # Rate state.
         self._last_t: Optional[float] = None
@@ -280,6 +292,7 @@ class HealthProbe:
         block_verifier=None,
         commit_observer=None,
         ingress=None,
+        host_monitor=None,
     ) -> "HealthProbe":
         if core is not None:
             self._core = core
@@ -291,6 +304,8 @@ class HealthProbe:
             self._commit_observer = commit_observer
         if ingress is not None:
             self._ingress = ingress
+        if host_monitor is not None:
+            self._host_monitor = host_monitor
         return self
 
     def detach(self) -> None:
@@ -419,6 +434,12 @@ class HealthProbe:
             # is SHEDDING reads differently from one silently drowning —
             # the whole point of the ingress plane (ingress.py).
             snapshot["ingress"] = self._ingress.health_state()
+        if self._host_monitor is not None:
+            # Host attribution plane (hostattr.py): loop-lag percentiles,
+            # blocking-call census, GIL convoy ratio.  All-zero under the
+            # sim (the probe and sampler never start in virtual time), so
+            # the deterministic timeline stays byte-identical.
+            snapshot["host"] = self._host_monitor.state()
         alerts = self._watchdog(snapshot, lags)
         snapshot["status"] = "degraded" if self._firing else "ok"
         self._export_gauges(snapshot, lags)
@@ -529,6 +550,28 @@ class HealthProbe:
                 slo.max_breaker_open_fraction, True,
                 "verifier circuit breaker open fraction over threshold",
             )
+        monitor = self._host_monitor
+        if monitor is not None:
+            host = snapshot.get("host") or monitor.state()
+            if slo.max_loop_lag_s > 0 and host["loop_lag_samples"] > 0:
+                check(
+                    "loop-lag", None, host["loop_lag_p99_s"],
+                    slo.max_loop_lag_s, True,
+                    f"event-loop lag p99 {host['loop_lag_p99_s'] * 1e3:.1f}ms"
+                    " over SLO",
+                )
+            if slo.max_blocking_call_ms > 0:
+                # Worst hold SINCE THE LAST SAMPLE: draining re-arms the
+                # alert after one clean interval, matching the other
+                # transition-edge kinds.
+                worst_ms = monitor.drain_worst_blocking_ms()
+                last = host.get("last_blocking") or {}
+                check(
+                    "blocking-call", None, worst_ms,
+                    slo.max_blocking_call_ms, True,
+                    f"synchronous {last.get('site', '?')} held the core "
+                    f"owner {worst_ms:.1f}ms",
+                )
         return new
 
     # -- diagnosis document (served next to /healthz) --
@@ -702,6 +745,8 @@ def node_health_from_series(series) -> dict:
         "committed_by_authority": {},
         "authority_lag_rounds": {},
         "slo_alerts": {},
+        "loop_lag_p99_s": 0.0,
+        "cpu_subsystems": {},
     }
     for name, labels, value in series:
         if name == "threshold_clock_round":
@@ -727,6 +772,15 @@ def node_health_from_series(series) -> dict:
         elif name == "mysticeti_health_slo_alerts_total":
             kind = labels.get("kind", "?")
             out["slo_alerts"][kind] = out["slo_alerts"].get(kind, 0.0) + value
+        elif name == "mysticeti_loop_lag_p99_seconds":
+            out["loop_lag_p99_s"] = value
+        elif name == "mysticeti_cpu_seconds_total":
+            # Attribution plane (profiling.py): per-subsystem CPU seconds,
+            # summed over thread classes for the fleet view.
+            sub = labels.get("subsystem", "?")
+            out["cpu_subsystems"][sub] = (
+                out["cpu_subsystems"].get(sub, 0.0) + value
+            )
     return out
 
 
@@ -775,6 +829,23 @@ def cluster_snapshot(
         "degraded_nodes": sorted(
             k for k, v in reachable.items() if not v["status_ok"]
         ),
+        # Host attribution plane: per-node loop responsiveness and the
+        # top-3 CPU consumers (busy subsystems only — idle is not a cost).
+        "loop_lag_p99_by_node": {
+            k: round(v.get("loop_lag_p99_s", 0.0), 6)
+            for k, v in sorted(reachable.items())
+        },
+        "top_cpu_subsystems": {
+            k: [
+                sub
+                for sub, _ in sorted(
+                    (v.get("cpu_subsystems") or {}).items(),
+                    key=lambda kv: (-kv[1], kv[0]),
+                )
+                if sub != "event-loop-idle"
+            ][:3]
+            for k, v in sorted(reachable.items())
+        },
     }
     reasons = []
     if snapshot["unreachable"]:
@@ -789,7 +860,23 @@ def cluster_snapshot(
     if slo is not None and slo.min_participation > 0 and reachable:
         if participation < slo.min_participation:
             reasons.append("participation")
-    snapshot["status"] = "degraded" if reasons else "ok"
+    # Loop-lag SLO breaches turn the gate YELLOW, not red: the node is
+    # answering and committing, but its event loop is running hot — a
+    # warning state, distinct from degraded (fleetmon still exits 0).
+    yellow = []
+    if slo is not None and slo.max_loop_lag_s > 0:
+        yellow = sorted(
+            k
+            for k, lag in snapshot["loop_lag_p99_by_node"].items()
+            if lag > slo.max_loop_lag_s
+        )
+    snapshot["yellow_nodes"] = yellow
+    if reasons:
+        snapshot["status"] = "degraded"
+    elif yellow:
+        snapshot["status"] = "yellow"
+    else:
+        snapshot["status"] = "ok"
     snapshot["degraded_reasons"] = reasons
     return snapshot
 

@@ -449,6 +449,86 @@ class Metrics:
                      5.0, 10.0, 30.0],
         )
 
+        # Host attribution plane (profiling.py accountant + hostattr.py):
+        # where host time goes, per subsystem, and what the event loop pays
+        # for it.  The cpu-seconds counter is fed by the sampling profiler's
+        # census (active when MYSTICETI_PROFILE is set); the loop-lag /
+        # blocking-call / convoy series are always on.
+        self.mysticeti_cpu_seconds_total = counter(
+            "mysticeti_cpu_seconds_total",
+            "sampled CPU seconds attributed to each subsystem of the "
+            "declarative registry (profiling.SUBSYSTEMS), split by thread "
+            "class (loop / verifier / wal / aux)",
+            labels=("subsystem", "thread_class"),
+        )
+        self.mysticeti_cpu_us_per_leader = gauge(
+            "mysticeti_cpu_us_per_leader",
+            "per-committed-leader normalized subsystem cost: sampled CPU "
+            "microseconds per committed leader (the PERF_ATTR budget rows)",
+            labels=("subsystem",),
+        )
+        self.mysticeti_loop_lag_seconds = histogram(
+            "mysticeti_loop_lag_seconds",
+            "asyncio loop scheduling lag: scheduled-vs-actual callback "
+            "delta of the loop-lag probe (hostattr.LoopLagProbe)",
+            buckets=[0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5],
+        )
+        self.mysticeti_loop_lag_p99_seconds = gauge(
+            "mysticeti_loop_lag_p99_seconds",
+            "p99 loop scheduling lag over the probe's bounded window (the "
+            "loop-lag SLO watchdog input; fleetmon dashboard column)",
+        )
+        self.mysticeti_gil_convoy_ratio = gauge(
+            "mysticeti_gil_convoy_ratio",
+            "fraction of census ticks where >=2 threads were runnable at "
+            "once — with one interpreter lock, a proxy for GIL convoying",
+        )
+        self.mysticeti_blocking_calls_total = counter(
+            "mysticeti_blocking_calls_total",
+            "synchronous core-owner commands that held the event loop past "
+            "MYSTICETI_BLOCKING_CALL_MS (the dynamic twin of the "
+            "async-blocking lint rule), by command site",
+            labels=("site",),
+        )
+        self.mysticeti_blocking_call_last_ms = gauge(
+            "mysticeti_blocking_call_last_ms",
+            "duration of the most recent detected blocking call, ms",
+        )
+        self.mysticeti_jax_compiles_total = counter(
+            "mysticeti_jax_compiles_total",
+            "JAX backend compile events observed in this process "
+            "(jax.monitoring; a climbing counter mid-run means a shape "
+            "escaped the fixed dispatch buckets)",
+        )
+        self.mysticeti_jax_compile_seconds_total = counter(
+            "mysticeti_jax_compile_seconds_total",
+            "cumulative seconds spent in JAX backend compilation",
+        )
+        self.mysticeti_jax_cache_hits_total = counter(
+            "mysticeti_jax_cache_hits_total",
+            "persistent compile-cache hits (kernels loaded instead of "
+            "recompiled)",
+        )
+        self.mysticeti_jax_cache_misses_total = counter(
+            "mysticeti_jax_cache_misses_total",
+            "persistent compile-cache misses (full compile paid)",
+        )
+        self.mysticeti_device_transfer_bytes_total = counter(
+            "mysticeti_device_transfer_bytes_total",
+            "bytes moved between host and device on the verifier hot path "
+            "(to_device = packed signature blobs, from_device = verdict "
+            "fetches)",
+            labels=("direction",),
+        )
+        self.mysticeti_verify_occupancy_fraction = gauge(
+            "mysticeti_verify_occupancy_fraction",
+            "fraction of cumulative verify-dispatch time in each phase "
+            "(device = device-busy, pack = host packing, fetch = "
+            "result-wait), from the verify_pipeline stage timers",
+            labels=("phase",),
+        )
+
         # Overload-resilient ingress plane (ingress.py): the admission-
         # controlled mempool's accounting.  Every transaction a node refuses
         # is on mysticeti_ingress_shed_total — silent drops were the PR 10

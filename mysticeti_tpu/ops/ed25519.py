@@ -774,6 +774,9 @@ def _dispatch_indexed_keyed(chunk: np.ndarray, table: "KeyTable", bucket: int):
     # (~343k vs ~388k sig/s) despite fewer bytes: the device-side
     # reshape/expand costs more than the wire saves here, so the plain
     # 26-column grouped upload stays the deployed path.
+    # positions never ride the link (see above), so only the grouped blob
+    # and the per-tile key ids count as upload traffic.
+    _note_transfer("to_device", grouped.nbytes + tile_keys.nbytes)
     handle = PK.verify_keyed_blob(
         grouped, table.words, acomb, tile_keys, None, tile=tile
     )
@@ -796,7 +799,9 @@ def dispatch_indexed_chunks(blob: np.ndarray, table: "KeyTable"):
         chunk = blob[start : start + count]
         hp = _dispatch_indexed_keyed(chunk, table, b) if keyed else None
         if hp is None:
-            h = _dispatch_indexed(jnp.asarray(_pad_to(chunk, b)), table.words)
+            padded = _pad_to(chunk, b)
+            _note_transfer("to_device", padded.nbytes)
+            h = _dispatch_indexed(jnp.asarray(padded), table.words)
             handles.append((count, h))
         else:
             h, positions = hp
@@ -958,6 +963,64 @@ def _backend() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
+# ---------------------------------------------------------------------------
+# Host attribution plane: device-side counters (the JAX half of
+# profiling.py's per-subsystem accountant).  All host-side bookkeeping — no
+# kernel changes.
+
+_attr_metrics = None
+_attr_listeners_installed = False
+
+
+def install_device_attribution(metrics) -> bool:
+    """Wire JAX compile events, compile-cache hits/misses, and the transfer
+    byte counters below into the node's registry (``mysticeti_jax_*`` and
+    ``mysticeti_device_transfer_bytes_total``, metrics.py).  Called once by
+    validators that verify in-process; re-calling swaps the target registry.
+    Returns whether the ``jax.monitoring`` listeners landed (the module is
+    semi-private, so every hook is best-effort)."""
+    global _attr_metrics, _attr_listeners_installed
+    _attr_metrics = metrics
+    if _attr_listeners_installed:
+        return True
+    try:
+        from jax import monitoring as _monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            m = _attr_metrics
+            if m is None:
+                return
+            if "cache_hit" in event:
+                m.mysticeti_jax_cache_hits_total.inc()
+            elif "cache_miss" in event or "cache_nonhit" in event:
+                m.mysticeti_jax_cache_misses_total.inc()
+
+        def _on_duration(event: str, duration: float, **kwargs) -> None:
+            m = _attr_metrics
+            if m is None:
+                return
+            if "compil" in event:  # matches compile/compilation variants
+                m.mysticeti_jax_compiles_total.inc()
+                m.mysticeti_jax_compile_seconds_total.inc(max(0.0, duration))
+
+        _monitoring.register_event_listener(_on_event)
+        _monitoring.register_event_duration_secs_listener(_on_duration)
+        _attr_listeners_installed = True
+        return True
+    except Exception:  # noqa: BLE001 - attribution must never break verify
+        return False
+
+
+def _note_transfer(direction: str, nbytes: int) -> None:
+    """Count host<->device bytes at the dispatch/fetch seams: JAX exposes no
+    portable transfer counter, but every verifier transfer flows through
+    dispatch_blob_chunks / dispatch_batch / fetch_handles, so counting the
+    (padded) array sizes there IS the device link traffic."""
+    m = _attr_metrics
+    if m is not None and nbytes > 0:
+        m.mysticeti_device_transfer_bytes_total.labels(direction).inc(nbytes)
+
+
 def _dispatch_fused(msg_words, s_words, host_ok) -> jnp.ndarray:
     if _backend() == "pallas":
         from . import ed25519_pallas as PK
@@ -1003,10 +1066,12 @@ def dispatch_blob_chunks(blob: np.ndarray):
     """Slice a packed (n, 33) blob into fixed-bucket chunks, pad each, and
     dispatch all of them asynchronously.  Returns [(count, device handle)];
     force with np.asarray(handle)[:count]."""
-    return [
-        (count, _dispatch_blob(jnp.asarray(_pad_to(blob[start : start + count], b))))
-        for start, count, b in iter_buckets(blob.shape[0])
-    ]
+    out = []
+    for start, count, b in iter_buckets(blob.shape[0]):
+        padded = _pad_to(blob[start : start + count], b)
+        _note_transfer("to_device", padded.nbytes)
+        out.append((count, _dispatch_blob(jnp.asarray(padded))))
+    return out
 
 
 def fetch_handles(handles) -> np.ndarray:
@@ -1031,6 +1096,7 @@ def fetch_handles(handles) -> np.ndarray:
     if len(unpacked) == 1:
         count, h, positions = unpacked[0]
         res = np.asarray(h)
+        _note_transfer("from_device", res.nbytes)
         if positions is not None:
             return np.array(res[positions])
         # np.array (not asarray): a writable copy, matching the multi-chunk
@@ -1038,6 +1104,7 @@ def fetch_handles(handles) -> np.ndarray:
         # bool row per signature, noise next to the transfer itself.
         return np.array(res[:count])
     flat = np.asarray(jnp.concatenate([h for _, h, _ in unpacked]))
+    _note_transfer("from_device", flat.nbytes)
     out = np.empty(sum(count for count, _, _ in unpacked), bool)
     src = dst = 0
     for count, h, positions in unpacked:
@@ -1073,15 +1140,13 @@ def dispatch_batch(
         # is paid at the end.
         return VerifyDispatch(dispatch_blob_chunks(blob))
     arrays = pack_batch(public_keys, messages, signatures)
-    handles = [
-        (
-            count,
-            verify_kernel(
-                *[jnp.asarray(_pad_to(x[start : start + count], b)) for x in arrays]
-            ),
+    handles = []
+    for start, count, b in iter_buckets(n):
+        padded = [_pad_to(x[start : start + count], b) for x in arrays]
+        _note_transfer("to_device", sum(p.nbytes for p in padded))
+        handles.append(
+            (count, verify_kernel(*[jnp.asarray(p) for p in padded]))
         )
-        for start, count, b in iter_buckets(n)
-    ]
     return VerifyDispatch(handles)
 
 

@@ -21,6 +21,7 @@ rather than a misleading 0.0.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Dict, Optional
 
@@ -56,10 +57,22 @@ class HostSampler:
                 self._procs.pop(pid, None)
         vm = psutil.virtual_memory()
         net = psutil.net_io_counters()
+        load_1m, load_5m, load_15m = os.getloadavg()
+        # CPU steal: time another guest on the hypervisor took from us —
+        # on a shared cloud box it explains loop-lag spikes no in-process
+        # attribution can (the GIL/host conditions a PERF_ATTR artifact
+        # was measured under).
+        steal = getattr(psutil.cpu_times_percent(None), "steal", None)
         return {
             "timestamp_s": time.time(),
             "cpu_pct": psutil.cpu_percent(None),
-            "load_1m": os.getloadavg()[0],
+            "load_1m": load_1m,
+            "load_5m": load_5m,
+            "load_15m": load_15m,
+            "cpu_steal_pct": steal,
+            # The GIL release cadence the run was measured under: a tuned
+            # sys.setswitchinterval changes every convoy/blocking number.
+            "switch_interval_s": sys.getswitchinterval(),
             "mem_available_mb": round(vm.available / 2**20, 1),
             "net_bytes_sent": net.bytes_sent,
             "net_bytes_recv": net.bytes_recv,
@@ -78,14 +91,16 @@ def parse_remote_sample(text: str) -> Optional[dict]:
     absent — one ssh round-trip per scrape keeps the remote side stateless)."""
     try:
         lines = [ln for ln in text.splitlines() if ln.strip()]
-        load_1m = float(lines[0].split()[0])
+        loads = lines[0].split()
         mem = {}
         for ln in lines[1:]:
             key, _, rest = ln.partition(":")
             mem[key.strip()] = float(rest.split()[0]) / 1024.0  # kB -> MB
         return {
             "timestamp_s": time.time(),
-            "load_1m": load_1m,
+            "load_1m": float(loads[0]),
+            "load_5m": float(loads[1]),
+            "load_15m": float(loads[2]),
             "mem_available_mb": round(mem.get("MemAvailable", 0.0), 1),
             "mem_total_mb": round(mem.get("MemTotal", 0.0), 1),
         }

@@ -106,6 +106,14 @@ def _make_verifier(kind: str, committee: Committee, metrics=None):
             )
         else:
             tpu_backend = TpuSignatureVerifier(committee_keys=committee_keys)
+            if metrics is not None:
+                # In-process JAX: wire device-side attribution (compile
+                # events, cache hits/misses, transfer bytes) into this
+                # node's registry.  Service-socket validators skip this —
+                # their process never imports jax.
+                from .ops import ed25519 as _ed25519
+
+                _ed25519.install_device_attribution(metrics)
         # "tpu" deploys the hybrid dispatch policy (small batches take the
         # CPU oracle, sparing them the accelerator round-trip — SURVEY §7
         # hard part #2); "tpu-only" pins every batch to the kernel, which is
@@ -155,6 +163,7 @@ class Validator:
         self.recorder: Optional[FlightRecorder] = None
         self.ingress: Optional[IngressPlane] = None
         self.gateway: Optional[IngressGateway] = None
+        self.host_monitor = None
 
     def _make_recorder(self, authority: int, lifecycle, observer):
         """The always-on flight recorder: ring in memory unconditionally,
@@ -172,8 +181,11 @@ class Validator:
 
     def _start_health(self, authority, committee, observer, block_verifier):
         """Wire the fleet health plane: probe + SLO watchdog + (when span
-        tracing is active) commit critical-path attribution."""
-        from . import spans
+        tracing is active) commit critical-path attribution + the host
+        attribution plane (hostattr.py: loop-lag probe, blocking-call
+        detector, GIL convoy estimate)."""
+        from . import profiling, spans
+        from .hostattr import HostMonitor
 
         probe = HealthProbe(
             authority,
@@ -187,14 +199,41 @@ class Validator:
                     os.environ.get("MYSTICETI_SLO_AUTHORITY_LAG", "100")
                 ),
                 max_breaker_open_fraction=0.5,
+                max_loop_lag_s=float(
+                    os.environ.get("MYSTICETI_SLO_LOOP_LAG_S", "0.25")
+                ),
+                max_blocking_call_ms=float(
+                    os.environ.get("MYSTICETI_SLO_BLOCKING_CALL_MS", "50")
+                ),
             ),
             recorder=self.recorder,
         )
+        monitor = HostMonitor(
+            metrics=self.metrics, recorder=self.recorder
+        ).start()
+        self.host_monitor = monitor
+        if self.network_syncer is not None:
+            # Every synchronous core command reports its wall duration to
+            # the blocking-call detector (core_task.py).
+            self.network_syncer.dispatcher.blocking_monitor = monitor
         probe.attach(
             core=self.core,
             net_syncer=self.network_syncer,
             block_verifier=block_verifier,
             commit_observer=observer,
+            host_monitor=monitor,
+        )
+        # Normalize the sampler's per-subsystem CPU seconds by committed
+        # leaders (mysticeti_cpu_us_per_leader) when MYSTICETI_PROFILE has
+        # an accountant running.
+        interpreter = getattr(observer, "commit_interpreter", None)
+        profiling.bind_active(
+            self.metrics,
+            leaders_fn=(
+                (lambda: interpreter.last_height)
+                if interpreter is not None
+                else None
+            ),
         )
         tracer = spans.active()
         if tracer is not None:
@@ -441,6 +480,8 @@ class Validator:
             self.reporter.stop(final=True)
         if self.health is not None:
             self.health.stop()
+        if self.host_monitor is not None:
+            self.host_monitor.stop()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if self.network_syncer is not None:

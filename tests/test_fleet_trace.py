@@ -507,6 +507,7 @@ def test_fleetmon_dump_on_red(tmp_path):
             fleet_dir=None, interval=0.1, duration=0.0, once=True,
             out=str(tmp_path / "fleetmon.json"), min_participation=0.0,
             max_ticks=10, no_dashboard=True, dump_on_red=True,
+            max_loop_lag=0.0,
         )
         rc = await fleetmon_run(args)
         server.close()

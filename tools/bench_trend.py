@@ -43,7 +43,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 ARTIFACT_GLOBS = (
     "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
-    "SCENARIO_*.json",
+    "SCENARIO_*.json", "PERF_ATTR_*.json",
 )
 
 # >10% below the best prior round fails the gate.
@@ -192,6 +192,33 @@ def normalize(path: str) -> List[dict]:
             return out
         return [_record(round_, source, "unparsed", None, "",
                         note="scenario artifact with no verdicts")]
+
+    # PERF_ATTR: the host attribution artifact (tools/perf_attr.py).  One
+    # budget row per subsystem, scored as committed leaders per CPU-second
+    # (HIGHER is better — the gate's direction), so any subsystem whose
+    # per-leader cost creeps >tolerance fires the generic gate; the raw
+    # µs/leader rides along as context.  attributed_ratio gates too: an
+    # attribution map decaying toward "other" is itself a regression.
+    if doc.get("metric") == "perf_attr":
+        for sub, rec in sorted((doc.get("subsystems") or {}).items()):
+            us = rec.get("us_per_leader") if isinstance(rec, dict) else None
+            if not us or us <= 0:
+                continue
+            out.append(_record(
+                round_, source, f"{family}.{sub}.leaders_per_cpu_s",
+                1e6 / us, "ldr/cpu-s",
+                us_per_leader=round(float(us), 3), cpu_s=rec.get("cpu_s"),
+                nodes=doc.get("nodes"),
+            ))
+        if doc.get("attributed_ratio") is not None:
+            out.append(_record(
+                round_, source, f"{family}.attributed_ratio",
+                doc["attributed_ratio"], "ratio",
+            ))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="perf_attr artifact with no subsystem rows")]
 
     # MAXLOAD_TAX: same-window A/B.
     if "tpu_over_cpu" in doc:

@@ -118,7 +118,10 @@ def weather_sample(sampler) -> Optional[dict]:
     sample = sampler.sample()
     return {
         k: sample[k]
-        for k in ("cpu_pct", "load_1m", "mem_available_mb")
+        for k in (
+            "cpu_pct", "load_1m", "load_5m", "load_15m", "mem_available_mb",
+            "cpu_steal_pct", "switch_interval_s",
+        )
         if k in sample
     }
 
@@ -145,20 +148,29 @@ def render_dashboard(snapshot: dict, targets, tick: int) -> str:
             "weather: "
             + "  ".join(f"{k}={v}" for k, v in sorted(weather.items()))
         )
-    lines.append(f"{'node':<6}{'state':<12}{'commit/s':>10}{'straggler':>12}")
+    lines.append(
+        f"{'node':<6}{'state':<12}{'commit/s':>10}{'straggler':>12}"
+        f"{'lag p99':>10}  {'top cpu subsystems':<32}"
+    )
     stragglers = snapshot.get("straggler_score", {})
     rates = snapshot.get("commit_rate_by_node", {})
+    lags = snapshot.get("loop_lag_p99_by_node", {})
+    top_subs = snapshot.get("top_cpu_subsystems", {})
     for i in range(len(targets)):
         node = str(i)
         if node in snapshot["unreachable"]:
             state = "UNREACHABLE"
         elif node in snapshot.get("degraded_nodes", []):
             state = "degraded"
+        elif node in snapshot.get("yellow_nodes", []):
+            state = "yellow"
         else:
             state = "ok"
+        lag_ms = lags.get(node, 0.0) * 1e3
         lines.append(
             f"{node:<6}{state:<12}{rates.get(node, 0.0):>10.3f}"
             f"{stragglers.get(node, 0):>12}"
+            f"{lag_ms:>8.1f}ms  {','.join(top_subs.get(node, []) or ['-']):<32}"
         )
     alerts = snapshot.get("slo_alert_totals", {})
     if alerts:
@@ -173,7 +185,10 @@ def render_dashboard(snapshot: dict, targets, tick: int) -> str:
 
 async def run(args) -> int:
     targets = resolve_targets(args)
-    slo = SLOThresholds(min_participation=args.min_participation)
+    slo = SLOThresholds(
+        min_participation=args.min_participation,
+        max_loop_lag_s=args.max_loop_lag,
+    )
     sampler = None
     try:
         from mysticeti_tpu.orchestrator.hostmon import HostSampler
@@ -242,7 +257,10 @@ async def run(args) -> int:
             timeline.pop(0)
             dropped_ticks += 1
         last_snapshot = snapshot
-        degraded_now = snapshot["status"] != "ok"
+        # Yellow (a loop-lag SLO breach: the fleet is committing but some
+        # node's event loop runs hot) warns on the dashboard without
+        # tripping the red machinery — only "degraded" dumps rings/exits 3.
+        degraded_now = snapshot["status"] == "degraded"
         if degraded_now and not prev_degraded and args.dump_on_red:
             # Dump AT the red transition, mid-run included: a fleet that
             # goes red at minute 10 of an hour-long watch must not wait
@@ -265,7 +283,9 @@ async def run(args) -> int:
         await asyncio.sleep(args.interval)
     if args.no_dashboard and last_snapshot is not None:
         print(render_dashboard(last_snapshot, targets, tick))
-    degraded = not (last_snapshot and last_snapshot["status"] == "ok")
+    degraded = not (
+        last_snapshot and last_snapshot["status"] in ("ok", "yellow")
+    )
     if degraded and args.dump_on_red:
         # Exit while red: refresh the dumps so the gate failure always
         # leaves the freshest rings (idempotent if the transition already
@@ -296,6 +316,9 @@ def main(argv=None) -> int:
                         help="JSON health-timeline path (atomically rewritten "
                         "every tick)")
     parser.add_argument("--min-participation", type=float, default=0.67)
+    parser.add_argument("--max-loop-lag", type=float, default=0.25,
+                        help="loop-lag p99 (s) past which a node shows "
+                        "yellow on the readiness gate (0 disables)")
     parser.add_argument("--max-ticks", type=int, default=2880,
                         help="keep at most this many timeline ticks in "
                         "memory/on disk (oldest roll off; default = 4h at "

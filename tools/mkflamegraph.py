@@ -4,6 +4,11 @@ format) to a self-contained flamegraph SVG.
 
 Usage:
     python tools/mkflamegraph.py node.folded [out.svg]
+    python tools/mkflamegraph.py --diff base.folded new.folded [out.svg]
+
+``--diff`` renders an A/B flame diff: frames laid out by the NEW profile,
+colored by their share change against the BASE (red grew, blue shrank) —
+the before/after view a perf-budget regression investigation starts from.
 
 Equivalent of the reference's ``orchestrator/assets/mkflamegraph.sh`` with
 the perf+flamegraph.pl pipeline replaced by the in-repo renderer.
@@ -13,14 +18,22 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from mysticeti_tpu.profiling import render_file  # noqa: E402
+from mysticeti_tpu.profiling import render_diff, render_file  # noqa: E402
 
 
 def main() -> int:
-    if len(sys.argv) < 2:
+    args = sys.argv[1:]
+    if args and args[0] == "--diff":
+        if len(args) < 3:
+            print(__doc__, file=sys.stderr)
+            return 2
+        out = render_diff(args[1], args[2], args[3] if len(args) > 3 else None)
+        print(out)
+        return 0
+    if not args:
         print(__doc__, file=sys.stderr)
         return 2
-    out = render_file(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
+    out = render_file(args[0], args[1] if len(args) > 1 else None)
     print(out)
     return 0
 
