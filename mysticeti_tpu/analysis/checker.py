@@ -1,8 +1,16 @@
-"""The six AST rules and the finding/baseline machinery.
+"""The syntactic AST rules, the analysis driver, and finding/baseline machinery.
 
-Pure stdlib (``ast``, ``json``, ``re``); no imports of the package under
-analysis, so the checker runs even when optional heavy deps (jax, numpy,
-prometheus_client) are absent or broken.
+Thirteen rules total: the eight per-call-site syntactic rules implemented
+here, the determinism/concurrency soundness analyses delegated to
+:mod:`.detflow` (``sim-taint``), :mod:`.races` (``await-atomicity``) and
+:mod:`.lockgraph` (``lock-order``, ``guard-inference``), plus the
+``unused-suppression`` hygiene rule.  This module also owns the repo-level
+driver (:func:`analyze_paths`): content-hash result caching, the
+multiprocessing per-file pass, and the cross-file rules.
+
+Pure stdlib (``ast``, ``json``, ``re``, ``tokenize``); no imports of the
+package under analysis, so the checker runs even when optional heavy deps
+(jax, numpy, prometheus_client) are absent or broken.
 
 Every rule is deliberately *syntactic* and scoped to this codebase's idioms:
 precision over generality.  A rule that cries wolf gets suppressed wholesale
@@ -27,6 +35,14 @@ RULE_WALL_CLOCK = "wall-clock"
 RULE_METRICS_LABELS = "metrics-labels"
 RULE_SPAN_NAMES = "span-names"
 RULE_METRICS_DOC = "metrics-doc"
+# Determinism/concurrency soundness plane (detflow.py, races.py,
+# lockgraph.py): dataflow and lock-graph rules, not per-call-site syntax.
+RULE_SIM_TAINT = "sim-taint"
+RULE_AWAIT_ATOMICITY = "await-atomicity"
+RULE_LOCK_ORDER = "lock-order"
+RULE_GUARD_INFERENCE = "guard-inference"
+# Suppression hygiene: an ignore comment must still suppress something.
+RULE_UNUSED_SUPPRESSION = "unused-suppression"
 
 RULES = (
     RULE_ASYNC_BLOCKING,
@@ -37,6 +53,11 @@ RULES = (
     RULE_METRICS_LABELS,
     RULE_SPAN_NAMES,
     RULE_METRICS_DOC,
+    RULE_SIM_TAINT,
+    RULE_AWAIT_ATOMICITY,
+    RULE_LOCK_ORDER,
+    RULE_GUARD_INFERENCE,
+    RULE_UNUSED_SUPPRESSION,
 )
 
 # -- rule configuration -------------------------------------------------------
@@ -146,7 +167,13 @@ JIT_IMPURE_PREFIXES = ("numpy.", "time.")
 # never closes) — every literal stage must come from spans.STAGES.
 SPAN_CALL_NAMES = {"span", "begin_span", "end_span", "record_span"}
 
-_IGNORE_RE = re.compile(r"#\s*lint:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+_IGNORE_RE = re.compile(r"#\s*lint:\s*ignore(?!-module)(?:\[([A-Za-z0-9_,\- ]+)\])?")
+# Whole-module opt-out for rules whose premise a module structurally
+# escapes (e.g. sim-taint on a socket-plane module that can never run
+# under the simulator: _NullSelector refuses the registration).  Placed
+# at the top of the module with its justification; exempt from
+# unused-suppression (it states an architectural fact, not a finding).
+_IGNORE_MODULE_RE = re.compile(r"#\s*lint:\s*ignore-module\[([A-Za-z0-9_,\- ]+)\]")
 
 
 @dataclass(frozen=True)
@@ -156,6 +183,10 @@ class Finding:
     line: int
     col: int
     message: str
+    # Additional lines where an inline suppression also silences this
+    # finding (e.g. a sim-taint finding is suppressible at its *source*
+    # read, not only at the sink).  Not part of identity.
+    also_lines: Tuple[int, ...] = ()
 
     def fingerprint(self) -> str:
         """Line-independent identity used by the baseline: survives pure
@@ -408,10 +439,30 @@ def collect_span_stages(tree: ast.Module) -> Optional[Tuple[str, ...]]:
     return None
 
 
+def comment_lines(source: str) -> Dict[int, str]:
+    """line -> comment text, via the tokenizer: a ``# lint: ...`` pattern
+    quoted inside a docstring or message string is prose *about* the
+    directive, not the directive — only real comments count."""
+    import io
+    import tokenize
+
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Unterminated construct mid-file: degrade to the raw-line scan.
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "#" in line:
+                out[i] = line
+    return out
+
+
 def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> suppressed rule set (None = all rules)."""
     out: Dict[int, Optional[Set[str]]] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
+    for i, line in comment_lines(source).items():
         m = _IGNORE_RE.search(line)
         if not m:
             continue
@@ -887,14 +938,38 @@ class _Checker(ast.NodeVisitor):
         super().generic_visit(node)
 
 
-def analyze_source(
+def _module_ignores(source: str) -> Set[str]:
+    out: Set[str] = set()
+    for line in comment_lines(source).values():
+        m = _IGNORE_MODULE_RE.search(line)
+        if m:
+            out.update(
+                part.strip() for part in m.group(1).split(",") if part.strip()
+            )
+    return out
+
+
+@dataclass
+class FileAnalysis:
+    """Raw per-module analysis: findings before suppression, plus the
+    lock census analyze_paths merges for the repo-level rules."""
+
+    path: str
+    findings: List[Finding]
+    locks: "object"  # lockgraph.ModuleLocks (kept loose for serialization)
+    suppressions: Dict[int, Optional[Set[str]]]
+    module_ignores: Set[str]
+
+
+def _analyze_module(
     source: str,
     path: str,
     metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None,
     span_stages: Optional[Tuple[str, ...]] = None,
-) -> List[Finding]:
-    """Run all rules over one module's source; returns findings with
-    inline ``# lint: ignore[...]`` suppressions already applied."""
+) -> FileAnalysis:
+    """Run every per-module rule; suppressions are recorded, not applied."""
+    from . import detflow, lockgraph, races
+
     tree = ast.parse(source, filename=path)
     aliases = _collect_aliases(tree)
     jit_targets = _collect_jit_targets(tree, aliases)
@@ -902,20 +977,106 @@ def analyze_source(
     # Rule 3b must also see module-level and __init__ assigns routed through
     # generic_visit; the NodeVisitor dispatch handles the rest.
     checker.visit(tree)
-    suppressed = _suppressions(source)
-    out: List[Finding] = []
-    for f in checker.findings:
-        rules = None
-        hit = False
-        for line in (f.line, f.line - 1):
-            if line in suppressed:
-                rules = suppressed[line]
-                if rules is None or f.rule in rules:
-                    hit = True
+    findings = list(checker.findings)
+    ignores = _module_ignores(source)
+
+    if RULE_SIM_TAINT not in ignores:
+        for tf in detflow.check_sim_taint(tree, aliases):
+            findings.append(
+                Finding(
+                    RULE_SIM_TAINT, path, tf.line, tf.col, tf.message,
+                    also_lines=(tf.source_line,) if tf.source_line else (),
+                )
+            )
+    if RULE_AWAIT_ATOMICITY not in ignores:
+        for rf in races.check_await_atomicity(tree, aliases, source):
+            findings.append(
+                Finding(RULE_AWAIT_ATOMICITY, path, rf.line, rf.col, rf.message)
+            )
+    locks = lockgraph.collect_module_locks(tree, aliases, path, source)
+    if RULE_GUARD_INFERENCE not in ignores:
+        for gf in lockgraph.check_guard_inference(locks, GUARDED_FIELDS):
+            findings.append(
+                Finding(RULE_GUARD_INFERENCE, path, gf.line, gf.col, gf.message)
+            )
+
+    return FileAnalysis(
+        path=path,
+        findings=[f for f in findings if f.rule not in ignores],
+        locks=locks,
+        suppressions=_suppressions(source),
+        module_ignores=ignores,
+    )
+
+
+def _apply_suppressions(
+    findings: Sequence[Finding],
+    suppressions: Dict[int, Optional[Set[str]]],
+) -> Tuple[List[Finding], Set[int]]:
+    """Drop suppressed findings; return (kept, comment lines that fired).
+
+    A finding is silenced by a matching ignore comment on its own line,
+    the line above, or (when the finding carries ``also_lines`` — the
+    sim-taint source read) any of those lines or the line above them.
+    """
+    kept: List[Finding] = []
+    used: Set[int] = set()
+    for f in findings:
+        hit_line: Optional[int] = None
+        for anchor in (f.line, *f.also_lines):
+            for line in (anchor, anchor - 1):
+                if line in suppressions:
+                    rules = suppressions[line]
+                    if rules is None or f.rule in rules:
+                        hit_line = line
+                    break
+            if hit_line is not None:
                 break
-        if not hit:
-            out.append(f)
-    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+        if hit_line is None:
+            kept.append(f)
+        else:
+            used.add(hit_line)
+    return kept, used
+
+
+def _unused_suppression_findings(
+    path: str,
+    suppressions: Dict[int, Optional[Set[str]]],
+    used: Set[int],
+) -> List[Finding]:
+    out: List[Finding] = []
+    for line, rules in sorted(suppressions.items()):
+        if line in used:
+            continue
+        what = "all rules" if rules is None else ", ".join(sorted(rules))
+        out.append(
+            Finding(
+                RULE_UNUSED_SUPPRESSION,
+                path,
+                line,
+                0,
+                f"suppression '# lint: ignore[{what}]' no longer matches any "
+                "finding — the bug it excused is gone (or the comment "
+                "drifted); delete it so suppressions cannot outlive their "
+                "justification",
+            )
+        )
+    return out
+
+
+def analyze_source(
+    source: str,
+    path: str,
+    metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None,
+    span_stages: Optional[Tuple[str, ...]] = None,
+) -> List[Finding]:
+    """Run all per-module rules over one source; returns findings with
+    inline ``# lint: ignore[...]`` suppressions already applied and
+    unused suppressions reported."""
+    fa = _analyze_module(source, path, metric_labels, span_stages)
+    kept, used = _apply_suppressions(fa.findings, fa.suppressions)
+    kept.extend(_unused_suppression_findings(path, fa.suppressions, used))
+    return sorted(kept, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 def analyze_file(
@@ -932,6 +1093,137 @@ def analyze_file(
     )
 
 
+# -- per-file cache + parallel gate -------------------------------------------
+#
+# The repo gate runs inside tier-1 on every test invocation; with the
+# dataflow rules the per-file pass is no longer trivially cheap.  Two
+# levers keep it off the critical path: a content-hash cache (a file whose
+# bytes and analysis toolchain are unchanged re-uses its raw findings) and
+# per-file multiprocessing for the misses.  Raw (pre-suppression)
+# results are cached so the repo-level rules and suppression hygiene can
+# still run over the merged set.
+
+CACHE_BASENAME = ".lint-cache.json"
+
+_tool_fp_cache: Optional[str] = None
+
+
+def _tool_fingerprint() -> str:
+    """Digest of the analysis package itself: edit a rule, drop the cache."""
+    global _tool_fp_cache
+    if _tool_fp_cache is None:
+        import hashlib
+
+        h = hashlib.sha256()
+        pkg_dir = os.path.dirname(os.path.abspath(__file__))
+        for name in sorted(os.listdir(pkg_dir)):
+            if name.endswith(".py"):
+                with open(os.path.join(pkg_dir, name), "rb") as fh:
+                    h.update(name.encode())
+                    h.update(fh.read())
+        _tool_fp_cache = h.hexdigest()
+    return _tool_fp_cache
+
+
+def _entry_key(source: str, context_fp: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(source.encode("utf-8", "surrogatepass"))
+    h.update(_tool_fingerprint().encode())
+    h.update(context_fp.encode())
+    return h.hexdigest()
+
+
+def _serialize_analysis(fa: FileAnalysis) -> dict:
+    locks = fa.locks
+    return {
+        "findings": [
+            [f.rule, f.line, f.col, f.message, list(f.also_lines)]
+            for f in fa.findings
+        ],
+        "edges": [
+            [e.held, e.acquired, e.path, e.line] for e in locks.edges
+        ],
+        "writes": [
+            [
+                cls,
+                attr,
+                census.guarded,
+                [[line, col, sorted(held)] for line, col, held in census.sites],
+                sorted(census.touched),
+            ]
+            for (cls, attr), census in sorted(locks.writes.items())
+        ],
+        "suppressions": {
+            str(line): (None if rules is None else sorted(rules))
+            for line, rules in fa.suppressions.items()
+        },
+        "module_ignores": sorted(fa.module_ignores),
+    }
+
+
+def _deserialize_analysis(path: str, data: dict) -> FileAnalysis:
+    from .lockgraph import FieldWrites, LockEdge, ModuleLocks
+
+    locks = ModuleLocks()
+    locks.edges = [
+        LockEdge(held, acquired, epath, line)
+        for held, acquired, epath, line in data["edges"]
+    ]
+    for cls, attr, guarded, sites, touched in data["writes"]:
+        census = FieldWrites()
+        census.guarded = {str(k): int(v) for k, v in guarded.items()}
+        census.sites = [
+            (line, col, frozenset(held)) for line, col, held in sites
+        ]
+        census.touched = set(touched)
+        locks.writes[(cls, attr)] = census
+    return FileAnalysis(
+        path=path,
+        findings=[
+            Finding(rule, path, line, col, message, also_lines=tuple(also))
+            for rule, line, col, message, also in data["findings"]
+        ],
+        locks=locks,
+        suppressions={
+            int(line): (None if rules is None else set(rules))
+            for line, rules in data["suppressions"].items()
+        },
+        module_ignores=set(data["module_ignores"]),
+    )
+
+
+def _pool_worker(args: Tuple) -> Tuple[str, dict]:
+    """Module-level so multiprocessing can pickle it."""
+    rel, source, metric_labels, span_stages = args
+    fa = _analyze_module(source, rel, metric_labels, span_stages)
+    return rel, _serialize_analysis(fa)
+
+
+def _load_cache(cache_path: str) -> Dict[str, dict]:
+    try:
+        with open(cache_path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    entries = data.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def _store_cache(cache_path: str, entries: Dict[str, dict]) -> None:
+    tmp = f"{cache_path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"entries": entries}, fh)
+        os.replace(tmp, cache_path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
     for path in paths:
         if os.path.isfile(path):
@@ -945,11 +1237,22 @@ def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
 
 
 def analyze_paths(
-    paths: Sequence[str], root: Optional[str] = None
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    jobs: Optional[int] = None,
+    use_cache: bool = True,
 ) -> List[Finding]:
     """Analyze every ``.py`` under ``paths``; the metrics-label registry is
     built from the first ``metrics.py`` encountered in the scanned set, and
-    the span-stage registry from the first ``spans.py``."""
+    the span-stage registry from the first ``spans.py``.
+
+    ``jobs``: worker processes for the per-file pass (``None`` = pick from
+    the CPU count; ``1`` = in-process).  ``use_cache``: re-use per-file
+    results for unchanged sources from ``<root>/.lint-cache.json``
+    (requires ``root``).
+    """
+    from . import lockgraph
+
     files = list(_iter_py_files(paths))
     metric_labels: Optional[Dict[str, Tuple[str, ...]]] = None
     span_stages: Optional[Tuple[str, ...]] = None
@@ -965,14 +1268,111 @@ def analyze_paths(
                 span_stages = collect_span_stages(ast.parse(fh.read()))
         if metric_labels is not None and span_stages is not None:
             break
-    findings: List[Finding] = []
+
+    def rel(path: str) -> str:
+        out = os.path.relpath(path, root) if root else path
+        return out.replace(os.sep, "/")
+
+    # Registry changes invalidate per-file results even when the file
+    # itself is byte-identical (metrics-labels / span-names look them up).
+    context_fp = repr((sorted((metric_labels or {}).items()), span_stages))
+
+    cache_path = (
+        os.path.join(root, CACHE_BASENAME) if (root and use_cache) else None
+    )
+    cached = _load_cache(cache_path) if cache_path else {}
+
+    sources: Dict[str, str] = {}
+    keys: Dict[str, str] = {}
+    analyses: Dict[str, FileAnalysis] = {}
+    misses: List[str] = []
     for path in files:
-        findings.extend(
-            analyze_file(
-                path, root=root, metric_labels=metric_labels,
-                span_stages=span_stages,
-            )
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        r = rel(path)
+        sources[r] = source
+        keys[r] = _entry_key(source, context_fp)
+        entry = cached.get(r)
+        if entry is not None and entry.get("key") == keys[r]:
+            try:
+                analyses[r] = _deserialize_analysis(r, entry["data"])
+                continue
+            except (KeyError, TypeError, ValueError):
+                pass
+        misses.append(r)
+
+    if jobs is None:
+        jobs = min(8, os.cpu_count() or 1)
+    if jobs > 1 and len(misses) >= 4:
+        import multiprocessing
+
+        try:
+            ctx = multiprocessing.get_context("fork")
+        except ValueError:  # platform without fork: spawn re-imports fine
+            ctx = multiprocessing.get_context("spawn")
+        work = [
+            (r, sources[r], metric_labels, span_stages) for r in misses
+        ]
+        try:
+            with ctx.Pool(min(jobs, len(work))) as pool:
+                for r, data in pool.map(_pool_worker, work):
+                    analyses[r] = _deserialize_analysis(r, data)
+            misses = []
+        except Exception:
+            pass  # pool unavailable (sandbox, recursion): fall through serial
+    for r in misses:
+        analyses[r] = _analyze_module(
+            sources[r], r, metric_labels, span_stages
         )
+
+    if cache_path:
+        _store_cache(
+            cache_path,
+            {
+                r: {"key": keys[r], "data": _serialize_analysis(fa)}
+                for r, fa in analyses.items()
+            },
+        )
+
+    findings: List[Finding] = []
+    for r in sorted(analyses):
+        findings.extend(analyses[r].findings)
+
+    # -- repo-level rules over the merged set ---------------------------------
+
+    # Lock-order: cycles in the package-wide acquisition graph.
+    all_edges = [e for fa in analyses.values() for e in fa.locks.edges]
+    for path_, line, message in lockgraph.lock_order_messages(
+        lockgraph.find_lock_cycles(all_edges)
+    ):
+        findings.append(Finding(RULE_LOCK_ORDER, path_, line, 0, message))
+
+    # Stale GUARDED_FIELDS annotations, anchored at the registry entry.
+    checker_rel = next(
+        (
+            r
+            for r in sorted(analyses)
+            if r.endswith("analysis/checker.py")
+        ),
+        None,
+    )
+    if checker_rel is not None:
+        checker_src = sources[checker_rel].splitlines()
+        for attr, _lock, message in lockgraph.stale_annotations(
+            [fa.locks for fa in analyses.values()], GUARDED_FIELDS
+        ):
+            line = next(
+                (
+                    i
+                    for i, text in enumerate(checker_src, start=1)
+                    if f'"{attr}"' in text and "GUARDED" not in text
+                ),
+                1,
+            )
+            findings.append(
+                Finding(RULE_GUARD_INFERENCE, checker_rel, line, 0, message)
+            )
+
     # Repo-level metrics-doc rule: runs whenever the scanned set contains
     # the package metrics.py and the repo carries docs/observability.md
     # (the series inventory of record).
@@ -984,17 +1384,29 @@ def analyze_paths(
                 metric_names = collect_metric_names(ast.parse(fh.read()))
             with open(doc, "r", encoding="utf-8") as fh:
                 doc_text = fh.read()
-
-            def rel(path: str) -> str:
-                out = os.path.relpath(path, root) if root else path
-                return out.replace(os.sep, "/")
-
             findings.extend(
                 check_metrics_doc(
                     metric_names, rel(metrics_py), doc_text, rel(doc)
                 )
             )
-    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # -- suppression application + hygiene ------------------------------------
+
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path_ in sorted(set(by_path) | set(analyses)):
+        group = by_path.get(path_, [])
+        fa = analyses.get(path_)
+        suppressions = fa.suppressions if fa is not None else {}
+        kept, used = _apply_suppressions(group, suppressions)
+        out.extend(kept)
+        if fa is not None:
+            out.extend(
+                _unused_suppression_findings(path_, suppressions, used)
+            )
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 # -- baseline -----------------------------------------------------------------

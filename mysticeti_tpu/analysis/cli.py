@@ -57,12 +57,103 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="emit findings as JSON",
+        help="emit findings as JSON (alias for --format json)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json", "sarif"),
+        default=None,
+        help="output format (sarif = SARIF 2.1.0 for CI/editor ingestion)",
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help=(
+            "report only findings in files changed vs git HEAD (staged, "
+            "unstaged, or untracked); the analysis itself still runs "
+            "repo-wide so cross-file rules stay sound"
+        ),
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        metavar="N",
+        help="worker processes for the per-file pass (default: auto; 1 = serial)",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore and do not write the per-file result cache (.lint-cache.json)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit"
     )
     return parser
+
+
+def _git_changed_files(repo: str) -> Optional[set]:
+    """Repo-relative paths changed vs HEAD (staged+unstaged+untracked);
+    None when git is unavailable (caller falls back to unfiltered)."""
+    import subprocess
+
+    changed: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=repo, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        changed.update(
+            line.strip() for line in proc.stdout.splitlines() if line.strip()
+        )
+    return changed
+
+
+def _to_sarif(findings) -> dict:
+    return {
+        "version": "2.1.0",
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "mysticeti-lint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [{"id": rule} for rule in RULES],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": max(1, f.line),
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -72,13 +163,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(rule)
         return 0
 
+    fmt = args.format or ("json" if args.as_json else "text")
+
     paths: List[str] = list(args.paths) or [_PACKAGE_ROOT]
     for path in paths:
         if not os.path.exists(path):
             print(f"error: no such path: {path}", file=sys.stderr)
             return 2
 
-    findings = analyze_paths(paths, root=_REPO_ROOT)
+    findings = analyze_paths(
+        paths,
+        root=_REPO_ROOT,
+        jobs=args.jobs,
+        use_cache=not args.no_cache,
+    )
 
     if args.baseline_regen:
         write_baseline(args.baseline, findings)
@@ -91,7 +189,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     fresh = new_findings(findings, baseline)
 
-    if args.as_json:
+    if args.changed:
+        changed = _git_changed_files(_REPO_ROOT)
+        if changed is None:
+            print(
+                "warning: --changed requested but git diff failed; "
+                "reporting all findings",
+                file=sys.stderr,
+            )
+        else:
+            fresh = [f for f in fresh if f.path in changed]
+
+    if fmt == "sarif":
+        print(json.dumps(_to_sarif(fresh), indent=2))
+    elif fmt == "json":
         print(
             json.dumps(
                 [

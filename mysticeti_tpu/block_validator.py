@@ -601,7 +601,10 @@ class HybridSignatureVerifier(SignatureVerifier):
             if provided is None:
                 started = time.monotonic()
                 self.tpu.verify_signatures([pk], [digest], [sig])
-                provided = (time.monotonic() - started, 0.0)
+                # Real-backend boot calibration only: sims construct oracle
+                # verifiers (chaos.py), so these EMAs keep their
+                # deterministic __init__ defaults in virtual time.
+                provided = (time.monotonic() - started, 0.0)  # lint: ignore[sim-taint]
         except self.BREAKER_EXCEPTIONS as exc:
             if isinstance(exc, VerifierProtocolError):
                 raise  # misconfiguration, not an outage: fail fast
@@ -613,7 +616,8 @@ class HybridSignatureVerifier(SignatureVerifier):
         started = time.monotonic()
         reps = 32
         self.cpu.verify_signatures([pk] * reps, [digest] * reps, [sig] * reps)
-        cpu_probe = (time.monotonic() - started) / reps
+        # Same boot-calibration exemption as the TPU probe above.
+        cpu_probe = (time.monotonic() - started) / reps  # lint: ignore[sim-taint]
         # Warmup runs on a background thread while live dispatches may
         # already be updating the EMAs from executor threads — the
         # calibration writes must join the same lock or a concurrent RMW
@@ -1230,7 +1234,7 @@ class BatchedSignatureVerifier(BlockVerifier):
             return ceiling
         return max(self.MIN_ADAPTIVE_DELAY_S, ceiling * expected / 2.0)
 
-    def _schedule_flush(self, loop) -> None:
+    def _schedule_flush(self, loop) -> None:  # lint: holds[_lock]
         """Arm the window timer (caller holds ``self._lock``) and publish
         the chosen window — the adaptive curve is otherwise invisible when
         a misroute needs debugging."""

@@ -1030,14 +1030,17 @@ def run_chaos_sim(
     per_node_parameters: Optional[Dict[int, Parameters]] = None,
     latency_ranges=None,
     committee: Optional[Committee] = None,
+    detsan=None,
 ) -> Tuple[ChaosReport, ChaosSimHarness]:
     """Run one chaos scenario to completion on a fresh DeterministicLoop.
 
     Returns the report plus the (stopped) harness so callers can inspect
     per-node metrics.  ``extra_fault(harness) -> awaitable`` is an optional
     test hook scheduled alongside the plan (e.g. killing an injected
-    verifier backend mid-run).  Raises :class:`SafetyViolation` if any
-    committed prefix ever diverged.
+    verifier backend mid-run).  ``detsan`` attaches a
+    :class:`mysticeti_tpu.detsan.DetsanRecorder` to the loop so two runs
+    of the same plan can be diffed event-by-event (tools/detsan.py).
+    Raises :class:`SafetyViolation` if any committed prefix ever diverged.
     """
     from .runtime.simulated import run_simulation
 
@@ -1137,4 +1140,4 @@ def run_chaos_sim(
             },
         )
 
-    return run_simulation(main(), seed=plan.seed), harness
+    return run_simulation(main(), seed=plan.seed, detsan=detsan), harness

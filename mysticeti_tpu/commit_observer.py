@@ -228,9 +228,12 @@ class TestCommitObserver(CommitObserver):
         construction + double iteration)."""
         import numpy as np
 
+        # Loop clock, not the wall: virtual under the simulator, so the
+        # benchmark-duration counter advances deterministically in a seeded
+        # sim instead of absorbing host scheduling.
         if self._bench_t0 is None:
-            self._bench_t0 = time.monotonic()
-        elapsed = time.monotonic() - self._bench_t0
+            self._bench_t0 = runtime_now()
+        elapsed = runtime_now() - self._bench_t0
         delta = int(elapsed) - int(self.metrics.benchmark_duration._value.get())
         if delta > 0:
             self.metrics.benchmark_duration.inc(delta)

@@ -306,7 +306,10 @@ class Core:
         )
         if self.options.fsync:
             self.wal_writer.sync()
-        elif self.wal_writer.pending():
+        # pending() is constantly False under the sim (walf() forces
+        # synchronous writes), so this durability drain cannot skew a
+        # seeded run — the PR 11 wal_backlog lesson, inverted.
+        elif self.wal_writer.pending():  # lint: ignore[sim-taint]
             # Durability floor for OWN proposals (ADVICE r5): the async
             # append queue parks acknowledged entries in process memory, so
             # without this drain a plain process crash (OOM/SIGKILL) after

@@ -594,7 +594,12 @@ class IngressPlane:
             # backpressure() already includes the core's wal_backlog tap.
             signals.update(syncer.backpressure())
         elif self._core is not None:
-            signals["wal_backlog"] = bool(self._core.wal_writer.pending())
+            # The PR 11 bug lived here: a real drain thread's queue depth
+            # steering virtual-time admission.  It is safe ONLY because
+            # sims construct the WAL with async_writes=False (walf), making
+            # pending() constantly False in virtual time — that discipline
+            # is what the suppression asserts.
+            signals["wal_backlog"] = bool(self._core.wal_writer.pending())  # lint: ignore[sim-taint]
         verifier = self._block_verifier
         state_fn = getattr(verifier, "health_state", None)
         if state_fn is not None:
@@ -743,10 +748,12 @@ class IngressGateway:
         return self
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # Swap before awaiting: a second stop() racing past the await of the
+        # first must see None, not close an already-closing server.
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
     async def _handle(self, reader, writer) -> None:
         self._conn_seq += 1

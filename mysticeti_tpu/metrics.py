@@ -614,6 +614,18 @@ class Metrics:
             "connection, by peer",
             labels=("peer",),
         )
+        # Determinism sanitizer plane (detsan.py + docs/static-analysis.md):
+        # wall-clock reads reaching package code while the deterministic
+        # virtual-time loop is running.  MUST stay zero in any healthy sim —
+        # a non-zero count is a reproducibility leak the sim-taint lint
+        # missed, attributed to the reading call-site (module:line).
+        self.mysticeti_detsan_wallclock_reads_total = counter(
+            "mysticeti_detsan_wallclock_reads_total",
+            "un-gated time.monotonic()/time()/perf_counter() reads from "
+            "package code under simulation, caught by the detsan tripwire "
+            "(strict mode raises WallClockLeak instead), by call-site",
+            labels=("site",),
+        )
         self.mysticeti_leader_wait_skipped_total = counter(
             "mysticeti_leader_wait_skipped_total",
             "proposal-gating waits skipped because the round's leader had "

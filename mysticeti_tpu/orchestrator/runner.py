@@ -63,6 +63,10 @@ async def _http_get_metrics(host: str, port: int, timeout: float = 5.0,
         return None
 
 
+# One orchestration coroutine drives start/run/stop sequentially; the
+# lifecycle fields never see a concurrent writer, so read-await-write
+# spans in these methods cannot interleave.
+# lint: single-owner[orchestrator]
 class LocalProcessRunner(Runner):
     def __init__(
         self,

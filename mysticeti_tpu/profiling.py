@@ -83,6 +83,8 @@ SUBSYSTEMS: Dict[str, str] = {
     # Tooling / harness code that can appear inside a node process.
     "cli": "tooling", "__main__": "tooling", "adversary": "tooling",
     "chaos": "tooling", "scenarios": "tooling", "checker": "tooling",
+    "detflow": "tooling", "races": "tooling", "lockgraph": "tooling",
+    "detsan": "tooling",
     "benchmark": "tooling", "display": "tooling", "faults": "tooling",
     "hostmon": "tooling", "logs": "tooling", "measurement": "tooling",
     "monitor": "tooling", "orchestrator": "tooling", "plot": "tooling",
@@ -393,7 +395,9 @@ class SamplingProfiler:
                     )
             if self.accountant is not None and census:
                 self.accountant.ingest_census(census, self.interval_s)
-            if self.flush_path and _time.monotonic() >= next_flush:
+            # Sampler-thread body: the profiler never starts under the sim
+            # (health.py gates it), so this cadence is real-mode-only.
+            if self.flush_path and _time.monotonic() >= next_flush:  # lint: ignore[sim-taint]
                 next_flush = _time.monotonic() + self.flush_every_s
                 try:
                     self.write_folded(self.flush_path)

@@ -73,7 +73,10 @@ class SimulatedNetwork:
         if rng is None:
             import random
 
-            return random.uniform(lo, hi)
+            # Reached only on a loop without a seeded .rng — i.e. a real
+            # event loop, which is nondeterministic anyway; DeterministicLoop
+            # always carries one.
+            return random.uniform(lo, hi)  # lint: ignore[sim-taint]
         return rng.uniform(lo, hi)
 
     async def _pump(self, src: int, dst: int, c_src: Connection, c_dst: Connection):

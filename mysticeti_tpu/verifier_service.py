@@ -1,3 +1,6 @@
+# lint: ignore-module[sim-taint] — standalone socket-server process: runs
+# outside any validator's event loop (real or simulated); its calibration
+# clocks can never leak into a seeded sim's timeline.
 """Shared per-host verifier service: ONE warmed JAX runtime for the fleet.
 
 Round-4 finding: giving every validator process its own JAX runtime
@@ -409,7 +412,7 @@ class VerifierServer:
                     done = _accounted()
                     if last_hello is not None:
                         # Awaited by the reply writer in order, which
-                        # observes its exception.  # lint: ignore[task-orphan]
+                        # observes its exception.
                         fut = asyncio.ensure_future(
                             _after_hello(last_hello, type_, req_id, n, body)
                         )

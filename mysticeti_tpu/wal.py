@@ -227,7 +227,10 @@ class WalWriter:
 
     def flush(self) -> None:
         """Block until every queued append has reached the file."""
-        if not self._async or self._thread is None or not self._thread.is_alive():
+        # Drain-thread liveness is real-mode-only state: sim WALs are
+        # synchronous (walf() forces async_writes=False), so ``_thread`` is
+        # None and these probes are constant in virtual time.
+        if not self._async or self._thread is None or not self._thread.is_alive():  # lint: ignore[sim-taint]
             if self._error is not None:
                 raise self._error
             return
@@ -236,7 +239,7 @@ class WalWriter:
         while not marker.wait(timeout=1.0):
             if self._error is not None:
                 raise self._error
-            if not self._thread.is_alive():
+            if not self._thread.is_alive():  # lint: ignore[sim-taint] (same: real drain thread only)
                 break
         if self._error is not None:
             raise self._error
@@ -293,7 +296,7 @@ class WalWriter:
             try:
                 self.flush()
             finally:
-                if self._thread is not None and self._thread.is_alive():
+                if self._thread is not None and self._thread.is_alive():  # lint: ignore[sim-taint] (same: real drain thread only)
                     self._queue.put(None)
                     self._thread.join(timeout=5.0)
                 os.close(self._fd)

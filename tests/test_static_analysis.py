@@ -477,6 +477,378 @@ def test_metrics_doc_repo_gate_inventory_is_complete():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+# -- rule 9: sim-taint --------------------------------------------------------
+
+# The PR 11 regression shape: a real drain-thread's progress census flows
+# through a module-wide dict key into another class's admission branch.
+_PR11_FIXTURE = """
+    class HealthProbe:
+        def __init__(self, core):
+            self.core = core
+
+        def sample(self):
+            signals = {}
+            signals["wal_backlog"] = bool(self.core.wal_writer.pending())
+            return signals
+
+
+    class AdmissionController:
+        def admit(self, signals):
+            if signals.get("wal_backlog"):
+                return False
+            return True
+"""
+
+# The PR 12 regression shape: a wall-clock dispatch measurement folds into a
+# field EMA, returns through a helper method, and arms a virtual-time timer.
+_PR12_FIXTURE = """
+    import time
+
+
+    class BatchedVerifier:
+        def __init__(self, loop):
+            self.loop = loop
+            self._dispatch_ema_s = 0.001
+
+        def _observe_dispatch(self, started):
+            wall = time.monotonic() - started
+            self._dispatch_ema_s = 0.9 * self._dispatch_ema_s + 0.1 * wall
+
+        def _effective_delay_s(self):
+            return min(0.05, self._dispatch_ema_s * 4.0)
+
+        def _arm_flush(self):
+            self.loop.call_later(self._effective_delay_s(), self._flush)
+
+        def _flush(self):
+            pass
+"""
+
+
+def test_sim_taint_catches_pr11_wal_backlog_shape():
+    findings = run(_PR11_FIXTURE)
+    assert "sim-taint" in rules_of(findings)
+    messages = " ".join(f.message for f in findings)
+    assert "thread-progress" in messages
+    assert "branch decision" in messages
+
+
+def test_sim_taint_catches_pr12_dispatch_ema_shape():
+    findings = run(_PR12_FIXTURE)
+    assert "sim-taint" in rules_of(findings)
+    messages = " ".join(f.message for f in findings)
+    assert "wall-clock" in messages
+    assert "timer delay" in messages
+
+
+def test_sim_taint_unseeded_random_into_timer():
+    findings = run(
+        """
+        import asyncio
+        import random
+
+        async def retry_pause():
+            await asyncio.sleep(random.uniform(0.05, 0.1))
+        """
+    )
+    assert rules_of(findings) == ["sim-taint"]
+    assert "unseeded-random" in findings[0].message
+
+
+def test_sim_taint_negative_gated_and_seeded():
+    findings = run(
+        """
+        import time
+
+        from .runtime import is_simulated, now as runtime_now
+
+
+        class Calibrator:
+            def __init__(self, rng):
+                self._rng = rng
+                self._cpu_probe = 0.0
+
+            def calibrate(self):
+                if not is_simulated():
+                    started = time.monotonic()
+                    self._cpu_probe = time.monotonic() - started
+                if self._cpu_probe > 0.5:
+                    return "slow"
+                return "fast"
+
+            async def jittered_pause(self, loop):
+                # seeded instance RNG: a different dotted head than the
+                # module-global random.*
+                await __import__("asyncio").sleep(self._rng.uniform(0.01, 0.02))
+
+            def stamp(self):
+                return runtime_now()
+        """
+    )
+    assert findings == []
+
+
+def test_sim_taint_suppression_at_source_silences_all_sinks():
+    # One ignore at the nondeterministic READ covers every downstream sink
+    # finding (suppression-at-cause, not per-sink).  The unsuppressed twin
+    # fires sim-taint (checked above), so an empty sim-taint set here means
+    # the single source-line comment silenced them all — and was counted as
+    # used (no unused-suppression finding either).
+    src = _PR12_FIXTURE.replace(
+        "wall = time.monotonic() - started",
+        "wall = time.monotonic() - started  # lint: ignore[sim-taint]",
+    )
+    rules = rules_of(run(src))
+    assert "sim-taint" not in rules
+    assert "unused-suppression" not in rules
+
+
+# -- rule 10: await-atomicity -------------------------------------------------
+
+def test_await_atomicity_positive_rmw_spans_await():
+    findings = run(
+        """
+        class Window:
+            async def refill(self):
+                budget = self.budget
+                await self._fetch()
+                self.budget = budget + 1
+        """
+    )
+    assert rules_of(findings) == ["await-atomicity"]
+    assert "budget" in findings[0].message
+
+
+def test_await_atomicity_positive_branch_then_write():
+    findings = run(
+        """
+        class Dispatcher:
+            async def maybe_flush(self):
+                if self._pending_count >= self.batch_size:
+                    batch = await self._drain()
+                    self._pending_count = 0
+                    return batch
+        """
+    )
+    assert rules_of(findings) == ["await-atomicity"]
+
+
+def test_await_atomicity_negative_lock_held_across_suspension():
+    findings = run(
+        """
+        import asyncio
+
+        class Window:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def refill(self):
+                async with self._lock:
+                    budget = self.budget
+                    await self._fetch()
+                    self.budget = budget + 1
+        """
+    )
+    assert findings == []
+
+
+def test_await_atomicity_negative_augassign_counter_pair():
+    # Each += / -= is its own atomic RMW (no read parked across the await).
+    findings = run(
+        """
+        class Gateway:
+            async def _handle(self, conn):
+                self.connections += 1
+                try:
+                    await self._serve(conn)
+                finally:
+                    self.connections -= 1
+        """
+    )
+    assert findings == []
+
+
+def test_await_atomicity_negative_while_retest_semaphore():
+    # The while condition re-evaluates AFTER the body's await: the read the
+    # write pairs with is post-suspension, not parked across it.
+    findings = run(
+        """
+        class Pipeline:
+            async def _acquire(self):
+                while self._inflight >= self.depth:
+                    await self._drained.wait()
+                self._inflight += 1
+        """
+    )
+    assert findings == []
+
+
+def test_await_atomicity_single_owner_annotation():
+    src = """
+        # lint: single-owner[core_task]
+        class CoreState:
+            async def advance(self):
+                round_ = self.round
+                await self._persist()
+                self.round = round_ + 1
+    """
+    assert run(src) == []
+    # Without the annotation the same shape fires.
+    stripped = src.replace("# lint: single-owner[core_task]", "pass")
+    assert rules_of(run(stripped)) == ["await-atomicity"]
+
+
+# -- rules 11+12: lock-order + guard-inference --------------------------------
+
+def test_lock_order_cycle_detected_across_methods():
+    import ast as _ast
+
+    from mysticeti_tpu.analysis.checker import _collect_aliases
+    from mysticeti_tpu.analysis.lockgraph import (
+        collect_module_locks,
+        find_lock_cycles,
+        lock_order_messages,
+    )
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """
+    )
+    tree = _ast.parse(src)
+    module = collect_module_locks(
+        tree, _collect_aliases(tree), "mysticeti_tpu/example.py", src
+    )
+    cycles = find_lock_cycles(module.edges)
+    assert cycles, "inverted acquisition order must form a cycle"
+    messages = lock_order_messages(cycles)
+    assert any("Pair._a" in m and "Pair._b" in m for _, _, m in messages)
+
+
+def test_lock_order_consistent_nesting_is_clean():
+    import ast as _ast
+
+    from mysticeti_tpu.analysis.checker import _collect_aliases
+    from mysticeti_tpu.analysis.lockgraph import (
+        collect_module_locks,
+        find_lock_cycles,
+    )
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """
+    )
+    tree = _ast.parse(src)
+    module = collect_module_locks(
+        tree, _collect_aliases(tree), "mysticeti_tpu/example.py", src
+    )
+    assert find_lock_cycles(module.edges) == []
+
+
+def test_guard_inference_flags_stray_unguarded_write():
+    findings = run(
+        """
+        import threading
+
+        class Census:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.tally = 0
+
+            def bump(self):
+                with self._lock:
+                    self.tally += 1
+
+            def bump2(self):
+                with self._lock:
+                    self.tally += 2
+
+            def reset(self):
+                self.tally = 0
+        """
+    )
+    assert "guard-inference" in rules_of(findings)
+    assert any("tally" in f.message for f in findings)
+
+
+def test_guard_inference_negative_all_writes_guarded():
+    findings = run(
+        """
+        import threading
+
+        class Census:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.tally = 0
+
+            def bump(self):
+                with self._lock:
+                    self.tally += 1
+
+            def reset(self):
+                with self._lock:
+                    self.tally = 0
+        """
+    )
+    assert findings == []
+
+
+def test_guard_inference_holds_annotation_covers_callee():
+    src = """
+        import threading
+
+        class Flusher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.dirty = 0
+
+            def mark(self):
+                with self._lock:
+                    self.dirty += 1
+
+            def mark2(self):
+                with self._lock:
+                    self.dirty += 2
+
+            def _drain(self):  # lint: holds[_lock]
+                self.dirty = 0
+    """
+    assert run(src) == []
+    stripped = src.replace("  # lint: holds[_lock]", "")
+    assert "guard-inference" in rules_of(run(stripped))
+
+
 # -- suppressions and baseline ------------------------------------------------
 
 def test_inline_suppression_matches_rule():
@@ -487,9 +859,47 @@ def test_inline_suppression_matches_rule():
             time.sleep(0.1)  # lint: ignore[async-blocking]
     """
     assert run(src) == []
-    # A suppression naming a DIFFERENT rule does not silence the finding.
+    # A suppression naming a DIFFERENT rule does not silence the finding —
+    # and the mismatched comment is itself flagged as unused.
     wrong = src.replace("async-blocking", "wall-clock")
-    assert rules_of(run(wrong)) == ["async-blocking"]
+    assert rules_of(run(wrong)) == ["async-blocking", "unused-suppression"]
+
+
+def test_unused_suppression_flagged_and_module_directive_exempt():
+    findings = run(
+        """
+        import asyncio
+
+        async def fine():
+            await asyncio.sleep(0.1)  # lint: ignore[async-blocking]
+        """
+    )
+    assert rules_of(findings) == ["unused-suppression"]
+    assert "async-blocking" in findings[0].message
+    # Module-wide directives document a file-level policy; they are exempt
+    # from staleness (their whole point is covering future code too).
+    assert run(
+        """
+        # lint: ignore-module[sim-taint]
+        import asyncio
+
+        async def fine():
+            await asyncio.sleep(0.1)
+        """
+    ) == []
+
+
+def test_suppression_text_inside_strings_is_not_a_directive():
+    # Only real COMMENT tokens count: a docstring or f-string mentioning the
+    # ignore syntax must neither suppress nor count as unused.
+    findings = run(
+        '''
+        def helper():
+            """Write `# lint: ignore[async-blocking]` at the call site."""
+            return "# lint: ignore[wall-clock]"
+        '''
+    )
+    assert findings == []
 
 
 def test_baseline_tolerates_exactly_the_recorded_count(tmp_path):
@@ -567,3 +977,79 @@ def test_lint_tool_alias():
     )
     assert proc.returncode == 0
     assert set(proc.stdout.split()) == set(RULES)
+
+
+# -- CI/editor integration: sarif, changed, cache, parallel -------------------
+
+def test_cli_sarif_format(tmp_path):
+    src = textwrap.dedent(
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        """
+    )
+    target = tmp_path / "fixture.py"
+    target.write_text(src)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "mysticeti_tpu.analysis",
+            "--no-baseline", "--no-cache", "--format", "sarif", str(target),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 1  # findings present
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run_ = doc["runs"][0]
+    assert run_["tool"]["driver"]["name"] == "mysticeti-lint"
+    assert {r["id"] for r in run_["tool"]["driver"]["rules"]} == set(RULES)
+    (result,) = run_["results"]
+    assert result["ruleId"] == "async-blocking"
+    region = result["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] > 1
+
+
+def test_cli_changed_mode_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mysticeti_tpu.analysis", "--changed"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_analyze_paths_cache_roundtrip_and_parallel(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    for i in range(6):
+        (pkg / f"mod{i}.py").write_text(textwrap.dedent(
+            f"""
+            import time
+
+            async def handler{i}():
+                time.sleep(0.{i + 1})
+            """
+        ))
+    root = str(tmp_path)
+    first = analyze_paths([str(pkg)], root=root, jobs=2)
+    assert len(first) == 6
+    cache_file = tmp_path / ".lint-cache.json"
+    assert cache_file.exists()
+    # Warm pass: identical results straight from the cache.
+    second = analyze_paths([str(pkg)], root=root, jobs=2)
+    assert [f.fingerprint() for f in second] == [
+        f.fingerprint() for f in first
+    ]
+    # Editing one file invalidates exactly its entry.
+    (pkg / "mod0.py").write_text("x = 1\n")
+    third = analyze_paths([str(pkg)], root=root)
+    assert len(third) == 5
+    # And disabling the cache still produces the same verdict.
+    assert len(analyze_paths([str(pkg)], root=root, use_cache=False)) == 5

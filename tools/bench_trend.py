@@ -43,7 +43,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 ARTIFACT_GLOBS = (
     "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
-    "SCENARIO_*.json", "PERF_ATTR_*.json",
+    "SCENARIO_*.json", "PERF_ATTR_*.json", "DETSAN_*.json",
 )
 
 # >10% below the best prior round fails the gate.
@@ -192,6 +192,49 @@ def normalize(path: str) -> List[dict]:
             return out
         return [_record(round_, source, "unparsed", None, "",
                         note="scenario artifact with no verdicts")]
+
+    # DETSAN: the determinism-sanitizer verdict (tools/detsan.py).  Every
+    # scored value is pass (1.0) / fail (0.0), so the generic gate fires
+    # exactly when a determinism property FLIPS — the clean run-twice sim
+    # stops being byte-identical, the bisector stops catching the planted
+    # leak, or a historical-fixture detection regresses.
+    if doc.get("metric") == "detsan":
+        clean = doc.get("clean") or {}
+        planted = doc.get("planted") or {}
+        if clean.get("identical") is not None:
+            out.append(_record(
+                round_, source, f"{family}.clean_identical",
+                1.0 if clean["identical"] else 0.0, "pass",
+                events=clean.get("events_a"), nodes=doc.get("nodes"),
+            ))
+        if planted.get("identical") is not None:
+            detected = (
+                not planted["identical"]
+                and planted.get("first_divergence") is not None
+            )
+            out.append(_record(
+                round_, source, f"{family}.planted_leak_bisected",
+                1.0 if detected else 0.0, "pass",
+                first_divergence_index=(
+                    (planted.get("first_divergence") or {}).get("index")
+                ),
+            ))
+        for name, detected in sorted((doc.get("fixtures") or {}).items()):
+            out.append(_record(
+                round_, source, f"{family}.fixture_{name}_detected",
+                1.0 if detected else 0.0, "pass",
+            ))
+        tripwire = doc.get("tripwire") or {}
+        if tripwire.get("strict_mode_raised") is not None:
+            out.append(_record(
+                round_, source, f"{family}.tripwire_strict_raises",
+                1.0 if tripwire["strict_mode_raised"] else 0.0, "pass",
+                counted_reads=tripwire.get("counted_reads"),
+            ))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="detsan artifact with no verdicts")]
 
     # PERF_ATTR: the host attribution artifact (tools/perf_attr.py).  One
     # budget row per subsystem, scored as committed leaders per CPU-second
