@@ -152,6 +152,22 @@ GUARDED_FIELDS: Dict[str, str] = {
     "_census_ticks": "_acct_lock",
     "_convoy_ticks": "_acct_lock",
     "_runnable_sum": "_acct_lock",
+    # Commit-decision ledger (decisions.DecisionLedger): the loop thread
+    # appends records during try_commit while the metrics endpoint serves
+    # /debug/consensus and tools snapshot the canonical ledger bytes —
+    # ring, flip-detection key set, and frontier tuple all move together
+    # under the decision lock or a snapshot reads a torn ledger.
+    "_decision_ring": "_decision_lock",
+    "_undecided_keys": "_decision_lock",
+    "_undecided_slots": "_decision_lock",
+    # Finality SLI joiner (finality.FinalityTracker): lifecycle stamps
+    # arrive from the thread-capable submit path, the loop's proposal
+    # drain, and the commit observer while the ingress tick reads
+    # percentiles — pending table and sample window share one lock.
+    # (ClientFinalityRecorder deliberately uses different field names —
+    # it is loop-thread-only and lock-free by design.)
+    "_finality_pending": "_finality_lock",
+    "_finality_samples": "_finality_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

@@ -621,8 +621,11 @@ class ChaosSimHarness:
             lifecycle.recorder = recorder
         # Equivocation detection (block_store.py) flows to the same ring:
         # a double-proposal observed seconds before a safety incident is
-        # exactly the forensic edge the recorder exists for.
+        # exactly the forensic edge the recorder exists for.  Commit-rule
+        # decision skips/flips (decisions.py) join it — a Byzantine run's
+        # skipped slots arrive pre-explained.
         core.block_store.recorder = recorder
+        core.committer.ledger.recorder = recorder
         verifier = (
             self.verifier_factory(
                 authority, self.committee, self.metrics[authority]

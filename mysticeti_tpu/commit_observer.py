@@ -52,7 +52,9 @@ class CommitObserver:
     # and the admission controller's progress signal.
     ingress = None
 
-    def _record_committed(self, committed: List[CommittedSubDag]) -> None:
+    def _record_committed(
+        self, committed: List[CommittedSubDag], t_commit: Optional[float] = None
+    ) -> None:
         if self.recorder is not None and committed:
             last = committed[-1]
             self.recorder.record(
@@ -62,7 +64,9 @@ class CommitObserver:
                 anchor=spans.format_ref(last.anchor),
             )
         if self.ingress is not None and committed:
-            self.ingress.note_committed(committed)
+            # t_commit = the observer's entry time (the commit decision);
+            # note_committed's own clock supplies the finalize time.
+            self.ingress.note_committed(committed, t_commit=t_commit)
 
     def handle_commit(
         self, committed_leaders: List[StatementBlock]
@@ -215,7 +219,7 @@ class TestCommitObserver(CommitObserver):
                 committed,
                 self.commit_interpreter.block_store.authority,
             )
-        self._record_committed(committed)
+        self._record_committed(committed, t_commit=now)
         return committed
 
     def _update_metrics_batch(self, heads: bytes, now: float) -> None:
@@ -278,13 +282,14 @@ class SimpleCommitObserver(CommitObserver):
 
     def handle_commit(self, committed_leaders):
         tracer = spans.active()
+        now = runtime_now()
         t0 = tracer.now() if tracer is not None else 0.0
         committed = self.commit_interpreter.handle_commit(committed_leaders)
         for commit in committed:
             self.sender(commit)
         if tracer is not None:
             _trace_committed(tracer, t0, committed, self.block_store.authority)
-        self._record_committed(committed)
+        self._record_committed(committed, t_commit=now)
         return committed
 
     def aggregator_state(self) -> bytes:

@@ -146,6 +146,11 @@ class IngressParameters:
     * ``tick_interval_s`` — admission controller cadence.
     * ``shed_log_capacity`` — bounded structured shed log (the deterministic
       overload sim asserts it byte-identical across same-seed runs).
+    * ``finality_sample_every`` — the finality SLI plane's content-based
+      count-sampling stride (finality.py): an ingress key participates in
+      the submit→finality phase join iff ``key_bytes % N == 0``, so all
+      nodes (and client generators) sample the SAME transactions without
+      coordination.  1 = every transaction, 0 = tracker disabled.
     """
 
     enabled: bool = True
@@ -167,6 +172,7 @@ class IngressParameters:
     gateway_port_base: int = 0
     tick_interval_s: float = 0.5
     shed_log_capacity: int = 10_000
+    finality_sample_every: int = 16
 
 
 @dataclass

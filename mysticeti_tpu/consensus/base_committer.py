@@ -101,11 +101,15 @@ class BaseCommitter:
         potential_certificate: StatementBlock,
         leader_block: StatementBlock,
         all_votes: Dict[BlockReference, bool],
+        trace=None,
     ) -> bool:
         """2f+1 stake of ``potential_certificate``'s includes vote for the leader.
 
         ``all_votes`` memoizes per-reference vote checks; it is only valid for one
-        ``leader_block`` (base_committer.rs:149-151).
+        ``leader_block`` (base_committer.rs:149-151).  ``trace`` (an optional
+        :class:`~mysticeti_tpu.decisions.DecisionTrace`) captures the vote
+        tally — the best one seen, whether or not quorum was reached — as a
+        side channel; it never affects the decision.
         """
         aggregator = StakeAggregator(QUORUM)
         for reference in potential_certificate.includes:
@@ -128,16 +132,22 @@ class BaseCommitter:
                 vote = self.is_vote(block, leader_block)
                 all_votes[reference] = vote
             if vote and aggregator.add(reference.authority, self.committee):
+                if trace is not None:
+                    trace.note_certificates(aggregator)
                 return True
+        if trace is not None:
+            trace.note_certificates(aggregator)
         return False
 
     # -- decisions --
 
     def decide_leader_from_anchor(
-        self, anchor: StatementBlock, leader: AuthorityRound
+        self, anchor: StatementBlock, leader: AuthorityRound, trace=None
     ) -> LeaderStatus:
         """Commit the target leader iff it has a certificate among the anchor's
         ancestors at the target's decision round (base_committer.rs:184-224)."""
+        if trace is not None:
+            trace.note_anchor(AuthorityRound(anchor.author(), anchor.round()))
         leader_blocks = self.block_store.get_blocks_at_authority_round(
             leader.authority, leader.round
         )
@@ -149,7 +159,7 @@ class BaseCommitter:
         for leader_block in leader_blocks:
             all_votes: Dict[BlockReference, bool] = {}
             if any(
-                self.is_certificate(pc, leader_block, all_votes)
+                self.is_certificate(pc, leader_block, all_votes, trace=trace)
                 for pc in potential_certificates
             ):
                 certified.append(leader_block)
@@ -162,19 +172,23 @@ class BaseCommitter:
         return LeaderStatus.skip(leader)
 
     def enough_leader_blame(
-        self, voting_round: RoundNumber, leader: AuthorityIndex
+        self, voting_round: RoundNumber, leader: AuthorityIndex, trace=None
     ) -> bool:
         """2f+1 stake of voting-round blocks with no include from the leader
         (base_committer.rs:228-249)."""
         aggregator = StakeAggregator(QUORUM)
+        quorum = False
         for voting_block in self.block_store.get_blocks_by_round(voting_round):
             if all(inc.authority != leader for inc in voting_block.includes):
                 if aggregator.add(voting_block.author(), self.committee):
-                    return True
-        return False
+                    quorum = True
+                    break
+        if trace is not None:
+            trace.note_blames(aggregator)
+        return quorum
 
     def enough_leader_support(
-        self, decision_round: RoundNumber, leader_block: StatementBlock
+        self, decision_round: RoundNumber, leader_block: StatementBlock, trace=None
     ) -> bool:
         """2f+1 stake of decision-round blocks that are certificates
         (base_committer.rs:253-289)."""
@@ -184,14 +198,20 @@ class BaseCommitter:
             return False
         aggregator = StakeAggregator(QUORUM)
         all_votes: Dict[BlockReference, bool] = {}
+        quorum = False
         for decision_block in decision_blocks:
+            # The trace tallies the outer aggregator (decision-round authors
+            # whose blocks certify the leader), not the per-block vote walks.
             if self.is_certificate(decision_block, leader_block, all_votes):
                 if aggregator.add(decision_block.author(), self.committee):
-                    return True
-        return False
+                    quorum = True
+                    break
+        if trace is not None:
+            trace.note_certificates(aggregator)
+        return quorum
 
     def try_indirect_decide(
-        self, leader: AuthorityRound, leaders: Iterable[LeaderStatus]
+        self, leader: AuthorityRound, leaders: Iterable[LeaderStatus], trace=None
     ) -> LeaderStatus:
         """Decide from the first committed anchor at least one wave later
         (base_committer.rs:294-318).  ``leaders`` is the (higher-round) decided
@@ -200,15 +220,15 @@ class BaseCommitter:
             if leader.round + self.options.wave_length > anchor.round:
                 continue
             if anchor.kind == LeaderStatus.COMMIT:
-                return self.decide_leader_from_anchor(anchor.block, leader)
+                return self.decide_leader_from_anchor(anchor.block, leader, trace=trace)
             if anchor.kind == LeaderStatus.UNDECIDED:
                 break
         return LeaderStatus.undecided(leader)
 
-    def try_direct_decide(self, leader: AuthorityRound) -> LeaderStatus:
+    def try_direct_decide(self, leader: AuthorityRound, trace=None) -> LeaderStatus:
         """The fast path (base_committer.rs:323-357)."""
         voting_round = leader.round + 1
-        if self.enough_leader_blame(voting_round, leader.authority):
+        if self.enough_leader_blame(voting_round, leader.authority, trace=trace):
             return LeaderStatus.skip(leader)
 
         wave = self.wave_number(leader.round)
@@ -218,7 +238,7 @@ class BaseCommitter:
             for block in self.block_store.get_blocks_at_authority_round(
                 leader.authority, leader.round
             )
-            if self.enough_leader_support(decision_round, block)
+            if self.enough_leader_support(decision_round, block, trace=trace)
         ]
         if len(supported) > 1:
             raise RuntimeError(

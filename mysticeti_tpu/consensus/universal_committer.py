@@ -18,6 +18,7 @@ from . import AuthorityRound, DEFAULT_WAVE_LENGTH, DIRECT, INDIRECT, LeaderStatu
 from .base_committer import BaseCommitter, BaseCommitterOptions
 from ..block_store import BlockStore
 from ..committee import Committee
+from ..decisions import DecisionLedger, DecisionTrace
 from ..types import AuthorityIndex, RoundNumber
 
 
@@ -31,12 +32,18 @@ class UniversalCommitter:
         self.block_store = block_store
         self.committers = committers
         self._metrics = metrics
+        # Why each slot decided the way it did — exports
+        # mysticeti_commit_decision_total{rule,outcome} (which replaced the
+        # old per-authority direct-commit/indirect-skip committed_leaders
+        # labels) and serves /debug/consensus.
+        self.ledger = DecisionLedger(metrics=metrics)
 
     def try_commit(self, last_decided: AuthorityRound) -> List[LeaderStatus]:
         """Idempotent scan for newly decidable leaders (universal_committer.rs:30-90)."""
         highest_known_round = self.block_store.highest_round()
         # Direct decision for round R needs blocks at R+2.
-        leaders: List[tuple] = []  # [(status, decision)] in increasing round order
+        # [(status, decision, trace)] in increasing round order
+        leaders: List[tuple] = []
         stop = False
         for round_ in range(max(0, highest_known_round - 2), last_decided.round - 1, -1):
             if stop:
@@ -48,27 +55,37 @@ class UniversalCommitter:
                 if leader == last_decided:
                     stop = True
                     break
-                status = committer.try_direct_decide(leader)
+                trace = DecisionTrace()
+                status = committer.try_direct_decide(leader, trace=trace)
                 decision = DIRECT
                 if not status.is_decided():
                     status = committer.try_indirect_decide(
-                        leader, (s for s, _ in leaders)
+                        leader, (s for s, _, _ in leaders), trace=trace
                     )
                     decision = INDIRECT
-                leaders.insert(0, (status, decision))
-        # Longest decided prefix, excluding genesis.
+                leaders.insert(0, (status, decision, trace))
+        # Longest decided prefix, excluding genesis.  Only the emitted prefix
+        # is recorded in the ledger: the core advances its cursor past it, so
+        # those slots are never rescanned (exactly one record per slot),
+        # while decided slots above the first undecided WILL be rescanned on
+        # a later call and must not be recorded yet.
         out: List[LeaderStatus] = []
-        for status, decision in leaders:
+        undecided: List[AuthorityRound] = []
+        emitting = True
+        for status, decision, trace in leaders:
             if status.round == 0:
                 continue
             if not status.is_decided():
-                break
+                emitting = False
+                undecided.append(status.authority_round)
+                continue
+            if not emitting:
+                continue
             out.append(status)
-            if self._metrics is not None:
-                label = "commit" if status.kind == LeaderStatus.COMMIT else "skip"
-                self._metrics.committed_leaders_total.labels(
-                    str(status.authority), f"{decision}-{label}"
-                ).inc()
+            self.ledger.record_decision(
+                status, decision, trace, highest_known_round - status.round
+            )
+        self.ledger.note_undecided(undecided)
         return out
 
     def get_leaders(self, round_: RoundNumber) -> List[AuthorityIndex]:

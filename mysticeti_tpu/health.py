@@ -62,6 +62,10 @@ ALERT_STAGES = {
     # starves block ingestion first, so both kinds indict the dag_add edge.
     "loop-lag": "dag_add",
     "blocking-call": "dag_add",
+    # Finality SLI plane (finality.py): a breaching submit→finalized p99
+    # means transactions linger between proposal and the observer, so the
+    # finalize edge is where to start looking.
+    "finality-p99": "finalize",
 }
 
 # Snapshot keys whose values depend on real-thread timing (the WAL drain
@@ -89,6 +93,8 @@ class SLOThresholds:
     # Host attribution plane (hostattr.py): event-loop responsiveness SLOs.
     max_loop_lag_s: float = 0.0  # loop-lag p99 ceiling
     max_blocking_call_ms: float = 0.0  # worst synchronous core-owner hold
+    # Finality SLI plane (finality.py): submit→finalized p99 ceiling.
+    max_finality_p99_s: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -100,6 +106,7 @@ class SLOThresholds:
             "min_participation": self.min_participation,
             "max_loop_lag_s": self.max_loop_lag_s,
             "max_blocking_call_ms": self.max_blocking_call_ms,
+            "max_finality_p99_s": self.max_finality_p99_s,
         }
 
     @staticmethod
@@ -115,6 +122,7 @@ class SLOThresholds:
             min_participation=float(d.get("min_participation", 0.0)),
             max_loop_lag_s=float(d.get("max_loop_lag_s", 0.0)),
             max_blocking_call_ms=float(d.get("max_blocking_call_ms", 0.0)),
+            max_finality_p99_s=float(d.get("max_finality_p99_s", 0.0)),
         )
 
 
@@ -574,6 +582,17 @@ class HealthProbe:
                     f"synchronous {last.get('site', '?')} held the core "
                     f"owner {worst_ms:.1f}ms",
                 )
+        if slo.max_finality_p99_s > 0:
+            fin = (snapshot.get("ingress") or {}).get("finality") or {}
+            # Armed only once samples exist: an idle node (or one with the
+            # tracker disabled) reports p99 = 0, not a breach or an all-clear.
+            if fin.get("samples", 0) > 0:
+                check(
+                    "finality-p99", None, fin["p99_s"],
+                    slo.max_finality_p99_s, True,
+                    f"submit->finalized p99 {fin['p99_s']:.3f}s over SLO "
+                    f"({fin['completed']} sampled tx completed)",
+                )
         return new
 
     # -- diagnosis document (served next to /healthz) --
@@ -748,6 +767,8 @@ def node_health_from_series(series) -> dict:
         "authority_lag_rounds": {},
         "slo_alerts": {},
         "loop_lag_p99_s": 0.0,
+        "finality_p50_s": 0.0,
+        "finality_p99_s": 0.0,
         "cpu_subsystems": {},
     }
     for name, labels, value in series:
@@ -776,6 +797,10 @@ def node_health_from_series(series) -> dict:
             out["slo_alerts"][kind] = out["slo_alerts"].get(kind, 0.0) + value
         elif name == "mysticeti_loop_lag_p99_seconds":
             out["loop_lag_p99_s"] = value
+        elif name == "mysticeti_e2e_finality_p50_seconds":
+            out["finality_p50_s"] = value
+        elif name == "mysticeti_e2e_finality_p99_seconds":
+            out["finality_p99_s"] = value
         elif name == "mysticeti_cpu_seconds_total":
             # Attribution plane (profiling.py): per-subsystem CPU seconds,
             # summed over thread classes for the fleet view.
@@ -837,6 +862,11 @@ def cluster_snapshot(
             k: round(v.get("loop_lag_p99_s", 0.0), 6)
             for k, v in sorted(reachable.items())
         },
+        # Finality SLI plane: per-node rolling submit→finalized percentiles.
+        "finality_p99_by_node": {
+            k: round(v.get("finality_p99_s", 0.0), 6)
+            for k, v in sorted(reachable.items())
+        },
         "top_cpu_subsystems": {
             k: [
                 sub
@@ -862,16 +892,23 @@ def cluster_snapshot(
     if slo is not None and slo.min_participation > 0 and reachable:
         if participation < slo.min_participation:
             reasons.append("participation")
-    # Loop-lag SLO breaches turn the gate YELLOW, not red: the node is
-    # answering and committing, but its event loop is running hot — a
-    # warning state, distinct from degraded (fleetmon still exits 0).
-    yellow = []
+    # Loop-lag and finality-p99 SLO breaches turn the gate YELLOW, not red:
+    # the node is answering and committing, but slowly — a warning state,
+    # distinct from degraded (fleetmon still exits 0).
+    yellow = set()
     if slo is not None and slo.max_loop_lag_s > 0:
-        yellow = sorted(
+        yellow.update(
             k
             for k, lag in snapshot["loop_lag_p99_by_node"].items()
             if lag > slo.max_loop_lag_s
         )
+    if slo is not None and slo.max_finality_p99_s > 0:
+        yellow.update(
+            k
+            for k, p99 in snapshot["finality_p99_by_node"].items()
+            if p99 > slo.max_finality_p99_s
+        )
+    yellow = sorted(yellow)
     snapshot["yellow_nodes"] = yellow
     if reasons:
         snapshot["status"] = "degraded"

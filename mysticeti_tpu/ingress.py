@@ -64,7 +64,8 @@ from .network import (
     decode_message,
     encode_message,
 )
-from .runtime import now as runtime_now
+from .finality import FinalityTracker
+from .runtime import now as runtime_now, timestamp_utc
 from .tracing import logger
 from .utils.tasks import spawn_logged
 
@@ -132,7 +133,9 @@ class _Lane:
     __slots__ = ("queue", "bytes", "priority", "drained", "shed")
 
     def __init__(self, priority: bool) -> None:
-        self.queue: Deque[bytes] = deque()
+        # (transaction, ingress_key) pairs: the key rides along so the
+        # drain can stamp finality-sampled keys without rehashing.
+        self.queue: Deque[Tuple[bytes, bytes]] = deque()
         self.bytes = 0
         self.priority = priority
         self.drained = 0
@@ -155,8 +158,11 @@ class Mempool:
     the core drains on the loop.
     """
 
-    def __init__(self, params: IngressParameters) -> None:
+    def __init__(self, params: IngressParameters, finality=None) -> None:
         self.params = params
+        # Optional FinalityTracker (finality.py): submit/drain stamp the
+        # admission and proposal phases for count-sampled keys.
+        self._finality = finality
         self._lanes: "OrderedDict[Tuple[str, bool], _Lane]" = OrderedDict()
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
         self._mempool_lock = threading.Lock()
@@ -166,12 +172,21 @@ class Mempool:
     # -- intake --
 
     def submit(
-        self, client: str, transactions: List[bytes], priority: bool = False
+        self,
+        client: str,
+        transactions: List[bytes],
+        priority: bool = False,
+        t_submit: Optional[float] = None,
     ) -> Tuple[int, Dict[str, int]]:
-        """Admit what fits; return ``(accepted, {shed_reason: count})``."""
+        """Admit what fits; return ``(accepted, {shed_reason: count})``.
+
+        ``t_submit`` is the caller-observed arrival time (defaults to the
+        admission time) for the finality tracker's admission phase."""
         params = self.params
+        fin = self._finality
         accepted = 0
         sheds: Dict[str, int] = {}
+        sampled_keys: List[bytes] = []
         with self._mempool_lock:
             lane = self._lanes.get((client, priority))
             if lane is None:
@@ -212,11 +227,21 @@ class Mempool:
                 self._seen[key] = None
                 if len(self._seen) > params.dedup_window:
                     self._seen.popitem(last=False)
-                lane.queue.append(tx)
+                lane.queue.append((tx, key))
                 lane.bytes += len(tx)
                 self._mempool_count += 1
                 self._mempool_bytes += len(tx)
                 accepted += 1
+                if fin is not None and fin.sampled(key):
+                    sampled_keys.append(key)
+        # Stamp outside _mempool_lock: the tracker has its own lock and the
+        # lock-order lint wants no nesting between the two planes.
+        if sampled_keys:
+            t_admitted = fin.clock()
+            if t_submit is None:
+                t_submit = t_admitted
+            for key in sampled_keys:
+                fin.on_submit(key, t_submit, t_admitted)
         return accepted, sheds
 
     def _evict_lane(self) -> bool:
@@ -237,7 +262,9 @@ class Mempool:
     def drain(self, budget: int) -> List[bytes]:
         if budget <= 0:
             return []
+        fin = self._finality
         out: List[bytes] = []
+        sampled_keys: List[bytes] = []
         with self._mempool_lock:
             if self._mempool_count == 0:
                 return out
@@ -255,11 +282,13 @@ class Mempool:
                     )
                     take = min(chunk, budget - len(out), len(lane.queue))
                     for _ in range(take):
-                        tx = lane.queue.popleft()
+                        tx, tx_key = lane.queue.popleft()
                         lane.bytes -= len(tx)
                         self._mempool_count -= 1
                         self._mempool_bytes -= len(tx)
                         out.append(tx)
+                        if fin is not None and fin.sampled(tx_key):
+                            sampled_keys.append(tx_key)
                     lane.drained += take
                     progressed = progressed or take > 0
                     if len(out) >= budget:
@@ -270,6 +299,10 @@ class Mempool:
                 first_key = lanes[0][0]
                 if first_key in self._lanes:
                     self._lanes.move_to_end(first_key)
+        if sampled_keys:
+            t = fin.clock()
+            for key in sampled_keys:
+                fin.on_proposal(key, t)
         return out
 
     # -- views --
@@ -427,7 +460,18 @@ class IngressPlane:
         self.metrics = metrics
         self.recorder = recorder
         self.clock = clock
-        self.mempool = Mempool(self.params)
+        # Server-side submit→finality phase joiner (finality.py) over
+        # count-sampled ingress keys; finality_sample_every=0 disables it.
+        self.finality = (
+            FinalityTracker(
+                metrics=metrics,
+                sample_every=self.params.finality_sample_every,
+                clock=clock,
+            )
+            if self.params.finality_sample_every > 0
+            else None
+        )
+        self.mempool = Mempool(self.params, finality=self.finality)
         self.controller = AdmissionController(self.params, clock=clock)
         # Submit-path accounting: submit() is callable from application
         # threads (same contract as Mempool), so the ledger and shed log
@@ -469,12 +513,14 @@ class IngressPlane:
         return self
 
     def add_commit_sink(
-        self, sink: Callable[[int, List[bytes]], None]
+        self, sink: Callable[[int, List[bytes], dict], None]
     ) -> None:
         """Register a commit-notification consumer (the gateway's
-        subscription stream).  Sinks receive ``(height, [ingress keys])``
-        per committed sub-dag; key extraction only runs while at least one
-        sink is registered."""
+        subscription stream).  Sinks receive
+        ``(height, [ingress keys], info)`` per committed sub-dag, where
+        ``info`` carries ``leader_round`` and ``committed_ts_ns`` for the
+        tag-16 wire suffix; key extraction only runs while at least one
+        sink (or the finality tracker) is active."""
         self._commit_sinks.append(sink)
 
     def remove_commit_sink(self, sink) -> None:
@@ -495,12 +541,14 @@ class IngressPlane:
         n = len(transactions)
         if n == 0:
             return SubmitResult(GATEWAY_ACK, 0, 0)
+        t_submit = self.clock()
         admitted_n, retry_ms = self.controller.admit(n)
         sheds: Dict[str, int] = {}
         if admitted_n < n:
             sheds[SHED_ADMISSION] = n - admitted_n
         accepted, pool_sheds = self.mempool.submit(
-            client, transactions[:admitted_n], priority=priority
+            client, transactions[:admitted_n], priority=priority,
+            t_submit=t_submit,
         )
         for reason, count in pool_sheds.items():
             sheds[reason] = sheds.get(reason, 0) + count
@@ -647,30 +695,51 @@ class IngressPlane:
         m.mysticeti_ingress_mempool_transactions.set(self.mempool.pending())
         m.mysticeti_ingress_mempool_bytes.set(self.mempool.pending_bytes())
         m.mysticeti_ingress_shed_mode.set(1 if shed_mode else 0)
+        if self.finality is not None:
+            self.finality.export_gauges()
 
     # -- commit feed (wired via CommitObserver.ingress) --
 
-    def note_committed(self, committed) -> None:
+    def note_committed(self, committed, t_commit: Optional[float] = None) -> None:
         """Feed from the committed sequence: track commit height and, when
-        subscribers exist, extract the committed transactions' ingress keys
-        per sub-dag (finalization_interpreter.py is the offline oracle the
-        tests cross-check this stream against)."""
+        subscribers or the finality tracker exist, extract the committed
+        transactions' ingress keys per sub-dag
+        (finalization_interpreter.py is the offline oracle the tests
+        cross-check this stream against).  ``t_commit`` is the observer's
+        commit-decision time for the finality commit phase (defaults to
+        now = the finalize time)."""
         from .types import Share
 
         if not committed:
             return
         self.commit_height = committed[-1].height
-        if not self._commit_sinks:
+        fin = self.finality
+        if not self._commit_sinks and fin is None:
             return
+        now = self.clock()
+        if t_commit is None:
+            t_commit = now
         for commit in committed:
             keys: List[bytes] = []
             for block in commit.blocks:
                 for st in block.statements:
                     if isinstance(st, Share):
                         keys.append(ingress_key(st.transaction))
+            if fin is not None:
+                for key in keys:
+                    if fin.sampled(key):
+                        fin.on_commit(key, t_commit, now)
+            if not self._commit_sinks:
+                continue
+            # Duck-typed commits (tests) may lack an anchor; default to 0.
+            anchor = getattr(commit, "anchor", None)
+            info = {
+                "leader_round": int(anchor.round) if anchor is not None else 0,
+                "committed_ts_ns": int(timestamp_utc() * 1e9),
+            }
             for sink in list(self._commit_sinks):
                 try:
-                    sink(commit.height, keys)
+                    sink(commit.height, keys, info)
                 except Exception:  # noqa: BLE001 - a dead sink must not stall commits
                     log.exception("ingress commit sink failed; removing")
                     self.remove_commit_sink(sink)
@@ -690,6 +759,11 @@ class IngressPlane:
             "admitted_total": admitted_total,
             "shed_by_reason": shed_by_reason,
             "commit_height": self.commit_height,
+            **(
+                {"finality": self.finality.state()}
+                if self.finality is not None
+                else {}
+            ),
         }
 
     # -- lifecycle (production nodes; sims drive tick() via the loop too) --
@@ -808,18 +882,36 @@ class IngressGateway:
                     if sink is not None:
                         self.plane.remove_commit_sink(sink)
                     from_height = msg.from_height
+                    # §5b soft extension: only clients that opted in get
+                    # the detail suffix — a pre-r17 client would reset the
+                    # connection on the longer frame otherwise.
+                    want_details = bool(getattr(msg, "want_details", 0))
 
                     # Live stream only: from_height FILTERS future
                     # notifications, it does not replay commits that
                     # happened before the subscription (wire-format §5b
                     # documents the gap contract for resuming clients).
-                    def sink(height, keys, q=outbound, fh=from_height):
+                    def sink(height, keys, info, q=outbound, fh=from_height,
+                             details=want_details):
                         if height <= fh:
                             return
-                        try:
-                            q.put_nowait(
-                                GatewayCommitNotification(height, tuple(keys))
+                        if details:
+                            note = GatewayCommitNotification(
+                                height,
+                                tuple(keys),
+                                leader_round=int(
+                                    info.get("leader_round", 0)
+                                ),
+                                committed_ts_ns=int(
+                                    info.get("committed_ts_ns", 0)
+                                ),
                             )
+                        else:
+                            note = GatewayCommitNotification(
+                                height, tuple(keys)
+                            )
+                        try:
+                            q.put_nowait(note)
                         except asyncio.QueueFull:
                             # A client not reading its notifications loses
                             # them (bounded queue, never the node's
@@ -829,6 +921,13 @@ class IngressGateway:
                                 m.mysticeti_ingress_shed_total.labels(
                                     "notify_backpressure"
                                 ).inc(len(keys))
+                            return
+                        fin = self.plane.finality
+                        if fin is not None:
+                            fin.on_notify(
+                                [k for k in keys if fin.sampled(k)],
+                                fin.clock(),
+                            )
 
                     self.plane.add_commit_sink(sink)
                 else:
@@ -934,6 +1033,11 @@ class OverloadReport:
     commit_heights: Dict[int, int]
     generator_stats: Dict[str, dict]
     shed_mode_entered: bool
+    # Finality SLI plane (defaults keep older constructors working):
+    # fleet-merged server-side submit→finalized and client-observed
+    # submit→notification percentiles over the sampled keys.
+    server_finality: Dict[str, float] = field(default_factory=dict)
+    client_finality: Dict[str, float] = field(default_factory=dict)
 
 
 def run_overload_sim(scenario: OverloadScenario) -> OverloadReport:
@@ -1065,13 +1169,25 @@ def run_overload_sim(scenario: OverloadScenario) -> OverloadReport:
                         lambda txs, p=plane, c=f"client-{i}": p.submit(c, txs)
                     )
                     name = f"a{authority}/client-{i}"
-                generators[name] = TransactionGenerator(
+                generator = TransactionGenerator(
                     submit=submit_fn,
                     seed=scenario.seed * 1000 + authority * 16 + i,
                     tps=max(1, scenario.base_tps // clients),
                     transaction_size=scenario.transaction_size,
                     overload_schedule=list(scenario.multiplier_schedule),
                     closed_loop=scenario.closed_loop,
+                    finality_sample_every=(
+                        scenario.ingress_parameters().finality_sample_every
+                    ),
+                )
+                generators[name] = generator
+                # Client-observed finality: this node's commit stream
+                # closes the client's sampled submit stamps (the sim's
+                # stand-in for a gateway subscription).
+                plane.add_commit_sink(
+                    lambda height, keys, info, g=generator: (
+                        g.note_commit_notification(keys, info)
+                    )
                 )
             planes.append(plane)
             nodes.append(node)
@@ -1141,4 +1257,28 @@ def run_overload_sim(scenario: OverloadScenario) -> OverloadReport:
             for entry in plane.shed_log
         )
         or any(p.controller.shed_mode for p in planes),
+        server_finality=_merged_finality(
+            [p.finality for p in planes if p.finality is not None]
+        ),
+        client_finality=_merged_finality(
+            [g.finality for g in generators.values() if g.finality is not None]
+        ),
     )
+
+
+def _merged_finality(trackers) -> Dict[str, float]:
+    """Fleet-merged finality percentiles over every tracker's recent
+    samples (server planes or client recorders — both expose samples())."""
+    from .finality import percentile
+
+    samples: List[float] = []
+    completed = 0
+    for tracker in trackers:
+        samples.extend(tracker.samples())
+        completed += tracker.completed
+    return {
+        "p50_s": round(percentile(samples, 0.50), 6),
+        "p99_s": round(percentile(samples, 0.99), 6),
+        "samples": len(samples),
+        "completed": completed,
+    }

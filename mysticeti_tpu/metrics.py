@@ -578,6 +578,62 @@ class Metrics:
             labels=("kind",),
         )
 
+        # Consensus decision ledger (decisions.py): why each leader slot
+        # decided — the structured replacement for the old per-authority
+        # direct-commit/indirect-skip committed_leaders_total labels.
+        self.mysticeti_commit_decision_total = counter(
+            "mysticeti_commit_decision_total",
+            "leader-slot decisions recorded by the decision ledger, by the "
+            "rule that decided (direct = blames/certificates in the slot's "
+            "own wave, indirect = a committed anchor one wave ahead) and "
+            "outcome (commit | skip); each decided slot counts exactly once",
+            labels=("rule", "outcome"),
+        )
+        self.mysticeti_decision_rounds_behind = histogram(
+            "mysticeti_decision_rounds_behind",
+            "how many rounds behind the DAG frontier a leader slot was when "
+            "it decided (direct decisions sit near wave_length - 1; large "
+            "values mean slots lingered undecided and resolved indirectly)",
+            buckets=[2.0, 3.0, 4.0, 6.0, 9.0, 15.0, 30.0, 60.0, 120.0],
+        )
+
+        # Client-perceived finality SLI plane (finality.py): the gateway's
+        # 16-byte ingress keys joined across the transaction lifecycle.
+        self.mysticeti_e2e_finality_seconds = histogram(
+            "mysticeti_e2e_finality_seconds",
+            "phase-split end-to-end finality latency for count-sampled "
+            "ingress keys: admission (submit -> mempool accept), proposal "
+            "(accept -> drained into a block proposal), commit (proposal -> "
+            "leader sequence commit), finalize (commit -> observer "
+            "finalized), notify (finalized -> gateway notification queued), "
+            "total (submit -> finalized)",
+            labels=("phase",),
+            buckets=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0],
+        )
+        self.mysticeti_e2e_finality_p50_seconds = gauge(
+            "mysticeti_e2e_finality_p50_seconds",
+            "rolling p50 of sampled submit -> finalized latency (exact over "
+            "the finality tracker's recent-sample window; feeds fleetmon)",
+        )
+        self.mysticeti_e2e_finality_p99_seconds = gauge(
+            "mysticeti_e2e_finality_p99_seconds",
+            "rolling p99 of sampled submit -> finalized latency — the "
+            "finality-p99 SLO watchdog input and the fleetmon readiness "
+            "gate column",
+        )
+        self.mysticeti_client_finality_p50_seconds = gauge(
+            "mysticeti_client_finality_p50_seconds",
+            "rolling p50 of CLIENT-observed submit -> commit-notification "
+            "latency from closed-loop generators (cross-checks the "
+            "server-side series in one artifact)",
+        )
+        self.mysticeti_client_finality_p99_seconds = gauge(
+            "mysticeti_client_finality_p99_seconds",
+            "rolling p99 of CLIENT-observed submit -> commit-notification "
+            "latency from closed-loop generators",
+        )
+
         # Robustness / chaos engineering.
         self.crash_recovery_total = counter(
             "crash_recovery_total",
@@ -754,7 +810,8 @@ class MetricReporter:
 
 
 async def serve_metrics(metrics: Metrics, host: str, port: int,
-                        health_probe=None, flight_recorder=None):
+                        health_probe=None, flight_recorder=None,
+                        consensus_debug=None):
     """Minimal asyncio HTTP endpoint (prometheus.rs:31-49): ``/metrics`` for
     the scraper, ``/healthz`` (200 + uptime) for liveness probes, and — when
     a :class:`~mysticeti_tpu.health.HealthProbe` is wired — ``/health``, the
@@ -762,7 +819,10 @@ async def serve_metrics(metrics: Metrics, host: str, port: int,
     the route doubles as a readiness gate).  With a
     :class:`~mysticeti_tpu.flight_recorder.FlightRecorder` wired,
     ``/debug/flight-recorder`` serves the live event-ring dump (the same
-    canonical document the SIGTERM/alert dumps write)."""
+    canonical document the SIGTERM/alert dumps write).  ``consensus_debug``
+    is a zero-arg callable returning the live consensus-state document (DAG
+    frontier, undecided slots, threshold-clock round, last-K decision
+    records) served on ``/debug/consensus``."""
     import json as _json
 
     started = time.monotonic()
@@ -788,6 +848,13 @@ async def serve_metrics(metrics: Metrics, host: str, port: int,
                 and flight_recorder is not None
             ):
                 body = flight_recorder.snapshot_bytes() + b"\n"
+                content_type = b"application/json"
+            elif (
+                path.split("?", 1)[0] == "/debug/consensus"
+                and consensus_debug is not None
+            ):
+                doc = consensus_debug()
+                body = (_json.dumps(doc, sort_keys=True) + "\n").encode()
                 content_type = b"application/json"
             elif path.split("?", 1)[0] == "/health" and health_probe is not None:
                 doc = health_probe.diagnosis()

@@ -252,18 +252,32 @@ class GatewaySubmitReply:
 class GatewaySubscribeCommits:
     """Client -> gateway: stream commit notifications from ``from_height``
     (exclusive) on (wire tag 15).  Notifications carry the 16-byte ingress
-    keys of committed transactions, the same keys the mempool dedups on."""
+    keys of committed transactions, the same keys the mempool dedups on.
+
+    ``want_details`` (soft suffix, wire-format §5b) opts the subscriber in
+    to the tag-16 detail suffix (leader round + commit timestamp) — an
+    opt-in because a pre-r17 client would reset the connection on the
+    longer notification frames (§7)."""
 
     from_height: int
+    want_details: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class GatewayCommitNotification:
     """Gateway -> client: transactions sequenced by the committed sub-dag at
-    ``height`` (wire tag 16), identified by their 16-byte ingress keys."""
+    ``height`` (wire tag 16), identified by their 16-byte ingress keys.
+
+    ``leader_round`` / ``committed_ts_ns`` form the soft detail suffix
+    (wire-format §5b): the sequencing leader's round and the node's
+    runtime commit timestamp, so clients compute finality without
+    scraping ``/metrics``.  Encoded only when nonzero AND the subscriber
+    asked (``want_details``); absent on the wire they decode as 0."""
 
     height: int
     keys: Tuple[bytes, ...]
+    leader_round: int = 0
+    committed_ts_ns: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -329,10 +343,19 @@ def encode_message(msg: NetworkMessage) -> bytes:
         w.bytes(msg.reason)
     elif isinstance(msg, GatewaySubscribeCommits):
         w.u8(_MSG_GATEWAY_SUBSCRIBE_COMMITS).u64(msg.from_height)
+        # Soft suffix (§5b): omitted when default so pre-r17 gateways (and
+        # the roundtrip equality tests) see the original short frame.
+        if msg.want_details:
+            w.u8(1)
     elif isinstance(msg, GatewayCommitNotification):
         w.u8(_MSG_GATEWAY_COMMITS).u64(msg.height).u32(len(msg.keys))
         for key in msg.keys:
             w.bytes(key)
+        # Soft suffix (§5b): leader round + commit timestamp, emitted only
+        # to subscribers that sent want_details (the gateway constructs
+        # default-0 notifications for everyone else).
+        if msg.leader_round or msg.committed_ts_ns:
+            w.u64(msg.leader_round).u64(msg.committed_ts_ns)
     else:  # pragma: no cover
         raise SerdeError(f"unknown message {type(msg)}")
     return w.finish()
@@ -393,12 +416,19 @@ def decode_message(data) -> NetworkMessage:
             r.u8(), r.u32(), r.u32(), r.u64(), bytes(r.bytes())
         )
     elif tag == _MSG_GATEWAY_SUBSCRIBE_COMMITS:
-        msg = GatewaySubscribeCommits(r.u64())
+        from_height = r.u64()
+        # §5b suffix: absent on frames from pre-r17 clients.
+        msg = GatewaySubscribeCommits(
+            from_height, r.u8() if not r.done() else 0
+        )
     elif tag == _MSG_GATEWAY_COMMITS:
         height = r.u64()
-        msg = GatewayCommitNotification(
-            height, tuple(bytes(r.bytes()) for _ in range(r.u32()))
-        )
+        keys = tuple(bytes(r.bytes()) for _ in range(r.u32()))
+        if not r.done():
+            # §5b suffix: leader round + commit timestamp.
+            msg = GatewayCommitNotification(height, keys, r.u64(), r.u64())
+        else:
+            msg = GatewayCommitNotification(height, keys)
     else:
         raise SerdeError(f"unknown message tag {tag}")
     r.expect_done()

@@ -60,6 +60,8 @@ SUBSYSTEMS: Dict[str, str] = {
     "linearizer": "linearizer", "base_committer": "linearizer",
     "universal_committer": "linearizer", "commit_observer": "linearizer",
     "finalization_interpreter": "linearizer",
+    # Decision ledger: recorded inline from try_commit on the core path.
+    "decisions": "linearizer",
     # Host-side digest/signature oracles.
     "crypto": "digest", "_ed25519_py": "digest",
     # Verifier hot path: batch collection, packing, kernels.
@@ -70,8 +72,9 @@ SUBSYSTEMS: Dict[str, str] = {
     "mesh": "verifier-pack",
     # Durability plane.
     "wal": "wal", "storage": "wal", "block_store": "wal",
-    # Client ingress.
+    # Client ingress (finality tracks submit→finality over ingress keys).
     "ingress": "ingress", "transactions_generator": "ingress",
+    "finality": "ingress",
     # Mesh data plane: frame encode/fan-out vs receive/decode.
     "net_sync": "mesh-parse", "synchronizer": "mesh-encode",
     "network": "mesh-encode", "simulated_network": "mesh-encode",

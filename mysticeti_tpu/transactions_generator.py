@@ -69,6 +69,8 @@ class TransactionGenerator:
         ready: Optional[Callable[[], bool]] = None,
         overload_schedule: Optional[Sequence[Tuple[float, float]]] = None,
         closed_loop: bool = False,
+        finality_sample_every: int = 0,
+        metrics=None,
     ) -> None:
         assert transaction_size >= 16, "needs room for timestamp + nonce"
         self.submit = submit
@@ -79,6 +81,7 @@ class TransactionGenerator:
         self.ready = ready
         self.overload_schedule = sorted(overload_schedule or [])
         self.closed_loop = closed_loop
+        self.metrics = metrics
         self._task: Optional[asyncio.Task] = None
         # Offered-load accounting (the OVERLOAD artifact's client ledger).
         self.submitted = 0
@@ -88,6 +91,17 @@ class TransactionGenerator:
         self.client_drops = 0
         self._retry_queue: Deque[bytes] = deque()
         self._hold_until = 0.0
+        # CLIENT-observed finality (finality.py): sampled submit stamps
+        # closed when commit notifications echo the ingress keys back.
+        # Same content-based sampling stride as the server tracker, so
+        # both sides measure the same transactions.  Loop-thread only.
+        self.finality = None
+        if finality_sample_every > 0:
+            from .finality import ClientFinalityRecorder
+
+            self.finality = ClientFinalityRecorder(
+                sample_every=finality_sample_every
+            )
 
     def make_batch(self, count: int) -> List[bytes]:
         now = timestamp_utc()
@@ -117,7 +131,7 @@ class TransactionGenerator:
         return current
 
     def stats(self) -> dict:
-        return {
+        out = {
             "submitted": self.submitted,
             "accepted": self.accepted,
             "shed_observed": self.shed_observed,
@@ -125,6 +139,25 @@ class TransactionGenerator:
             "client_drops": self.client_drops,
             "retry_queue": len(self._retry_queue),
         }
+        if self.finality is not None:
+            p = self.finality.percentiles()
+            out["client_finality_p50_s"] = round(p["p50_s"], 6)
+            out["client_finality_p99_s"] = round(p["p99_s"], 6)
+            out["client_finality_samples"] = p["samples"]
+        return out
+
+    def note_commit_notification(self, keys, info=None) -> None:
+        """Commit-notification feed (an ingress-plane sink or the gateway
+        subscription stream): close client-observed finality for sampled
+        keys this client submitted.  ``info`` (leader round, commit
+        timestamp) is accepted for sink-signature compatibility."""
+        if self.finality is None:
+            return
+        self.finality.note_finalized(keys)
+        if self.metrics is not None:
+            p = self.finality.percentiles()
+            self.metrics.mysticeti_client_finality_p50_seconds.set(p["p50_s"])
+            self.metrics.mysticeti_client_finality_p99_seconds.set(p["p99_s"])
 
     def start(self) -> asyncio.Task:
         self._task = asyncio.get_event_loop().create_task(self._run())
@@ -132,6 +165,13 @@ class TransactionGenerator:
 
     def _offer(self, batch: List[bytes]) -> None:
         """One submission, honoring the closed-loop contract when armed."""
+        if self.finality is not None:
+            from .ingress import ingress_key
+
+            for tx in batch:
+                # note_submitted keeps the FIRST stamp on retries, so the
+                # sample covers the whole client-experienced wait.
+                self.finality.note_submitted(ingress_key(tx))
         result = self.submit(batch)
         self.submitted += len(batch)
         if result is None or not self.closed_loop:

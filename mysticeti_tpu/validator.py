@@ -205,6 +205,9 @@ class Validator:
                 max_blocking_call_ms=float(
                     os.environ.get("MYSTICETI_SLO_BLOCKING_CALL_MS", "50")
                 ),
+                max_finality_p99_s=float(
+                    os.environ.get("MYSTICETI_SLO_FINALITY_P99_S", "5")
+                ),
             ),
             recorder=self.recorder,
         )
@@ -327,8 +330,10 @@ class Validator:
             os.environ.get("TRANSACTION_SIZE", str(transaction_size))
         )
         recorder = v._make_recorder(authority, lifecycle, observer)
-        # Equivocation detection events (block_store.py) ride the ring too.
+        # Equivocation detection events (block_store.py) ride the ring too,
+        # as do decision-skip/flip events from the commit-rule ledger.
         core.block_store.recorder = recorder
+        core.committer.ledger.recorder = recorder
         block_verifier = _make_verifier(verifier, committee, v.metrics)
         # Overload modes (tools/overload_bench.py drives these through the
         # environment): an offered-load multiplier schedule and a closed
@@ -350,6 +355,20 @@ class Validator:
                 os.environ.get("MYSTICETI_CLOSED_LOOP", "") == "1"
                 and plane is not None
             ),
+            # Client-observed finality: armed whenever the server-side
+            # tracker runs (or forced via MYSTICETI_CLIENT_FINALITY=1), with
+            # the same content-based sampling stride so both sides measure
+            # the same transactions.
+            finality_sample_every=(
+                parameters.ingress.finality_sample_every
+                if plane is not None
+                and (
+                    plane.finality is not None
+                    or os.environ.get("MYSTICETI_CLIENT_FINALITY", "") == "1"
+                )
+                else 0
+            ),
+            metrics=v.metrics,
         )
         if network is None:
             network = await TcpNetwork.start(
@@ -383,6 +402,14 @@ class Validator:
             )
             if v.health is not None:
                 v.health.attach(ingress=plane)
+            if v.generator.finality is not None:
+                # The loopback notification path: commit sinks fire on the
+                # loop thread, same thread the generator stamps on.
+                plane.add_commit_sink(
+                    lambda height, keys, info, g=v.generator: (
+                        g.note_commit_notification(keys, info)
+                    )
+                )
             v.ingress = plane.start()
             if parameters.ingress.gateway_port_base:
                 v.gateway = await IngressGateway(
@@ -395,8 +422,32 @@ class Validator:
             v._metrics_server = await serve_metrics(
                 v.metrics, "0.0.0.0", port, health_probe=v.health,
                 flight_recorder=recorder,
+                consensus_debug=v._consensus_debug_doc,
             )
         return v
+
+    def _consensus_debug_doc(self) -> dict:
+        """The live ``/debug/consensus`` document: DAG frontier, undecided
+        slots, threshold-clock state, and the last-K decision records."""
+        core = self.core
+        store = core.block_store
+        ledger = core.committer.ledger
+        state = ledger.state()
+        return {
+            "authority": core.authority,
+            "threshold_clock_round": core.current_round(),
+            "last_decided": repr(core.last_decided_leader),
+            "highest_round": store.highest_round(),
+            "frontier": {
+                str(a): store.last_seen_by_authority(a)
+                for a in range(len(core.committee))
+            },
+            "undecided": state["undecided"],
+            "recorded": state["recorded"],
+            "dropped": state["dropped"],
+            "ledger_digest": ledger.digest(),
+            "records": ledger.records(64),
+        }
 
     # -- production node (validator.rs:165-212) --
 
@@ -451,6 +502,7 @@ class Validator:
             )
         recorder = v._make_recorder(authority, lifecycle, observer)
         core.block_store.recorder = recorder
+        core.committer.ledger.recorder = recorder
         block_verifier = _make_verifier(verifier, committee, v.metrics)
         v.network_syncer = NetworkSyncer(
             core,

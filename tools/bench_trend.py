@@ -44,6 +44,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 ARTIFACT_GLOBS = (
     "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
     "SCENARIO_*.json", "PERF_ATTR_*.json", "DETSAN_*.json",
+    "FINALITY_*.json",
 )
 
 # >10% below the best prior round fails the gate.
@@ -235,6 +236,41 @@ def normalize(path: str) -> List[dict]:
             return out
         return [_record(round_, source, "unparsed", None, "",
                         note="detsan artifact with no verdicts")]
+
+    # FINALITY: the submit→finality SLI artifact (tools/finality_bench.py).
+    # Latency is lower-is-better, so the SCORED value is its inverse
+    # (finalizations per second at the percentile) — the generic
+    # higher-is-better gate then fires exactly when p50/p99 finality gets
+    # >tolerance SLOWER; the raw seconds ride along as context.  The
+    # decision-ledger and server/client cross-check verdicts score as
+    # pass (1.0) / fail (0.0) like the scenario matrix.
+    if doc.get("metric") == "finality":
+        server = doc.get("server") or {}
+        for pct in ("p50", "p99"):
+            seconds = server.get(f"{pct}_s")
+            if seconds and seconds > 0:
+                out.append(_record(
+                    round_, source, f"{family}.finality_{pct}_inv",
+                    1.0 / seconds, "1/s",
+                    seconds=round(float(seconds), 6),
+                    samples=server.get("samples"), nodes=doc.get("nodes"),
+                ))
+        acceptance = doc.get("acceptance") or {}
+        for key in ("client_cross_check", "every_slot_explained"):
+            if acceptance.get(key) is not None:
+                out.append(_record(round_, source, f"{family}.{key}",
+                                   1.0 if acceptance[key] else 0.0, "pass"))
+        determinism = doc.get("determinism") or {}
+        if determinism.get("byte_identical") is not None:
+            out.append(_record(
+                round_, source, f"{family}.ledger_byte_identical",
+                1.0 if determinism["byte_identical"] else 0.0, "pass",
+                digest=determinism.get("digest"),
+            ))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="finality artifact with no scored percentiles")]
 
     # PERF_ATTR: the host attribution artifact (tools/perf_attr.py).  One
     # budget row per subsystem, scored as committed leaders per CPU-second

@@ -150,11 +150,12 @@ def render_dashboard(snapshot: dict, targets, tick: int) -> str:
         )
     lines.append(
         f"{'node':<6}{'state':<12}{'commit/s':>10}{'straggler':>12}"
-        f"{'lag p99':>10}  {'top cpu subsystems':<32}"
+        f"{'lag p99':>10}{'fin p99':>10}  {'top cpu subsystems':<32}"
     )
     stragglers = snapshot.get("straggler_score", {})
     rates = snapshot.get("commit_rate_by_node", {})
     lags = snapshot.get("loop_lag_p99_by_node", {})
+    finality = snapshot.get("finality_p99_by_node", {})
     top_subs = snapshot.get("top_cpu_subsystems", {})
     for i in range(len(targets)):
         node = str(i)
@@ -167,10 +168,13 @@ def render_dashboard(snapshot: dict, targets, tick: int) -> str:
         else:
             state = "ok"
         lag_ms = lags.get(node, 0.0) * 1e3
+        fin_ms = finality.get(node, 0.0) * 1e3
         lines.append(
             f"{node:<6}{state:<12}{rates.get(node, 0.0):>10.3f}"
             f"{stragglers.get(node, 0):>12}"
-            f"{lag_ms:>8.1f}ms  {','.join(top_subs.get(node, []) or ['-']):<32}"
+            f"{lag_ms:>8.1f}ms"
+            f"{fin_ms:>8.0f}ms  "
+            f"{','.join(top_subs.get(node, []) or ['-']):<32}"
         )
     alerts = snapshot.get("slo_alert_totals", {})
     if alerts:
@@ -188,6 +192,9 @@ async def run(args) -> int:
     slo = SLOThresholds(
         min_participation=args.min_participation,
         max_loop_lag_s=args.max_loop_lag,
+        # getattr: programmatic callers build a bare Namespace (the
+        # fleet-trace test does) and must keep working with old arg sets.
+        max_finality_p99_s=getattr(args, "max_finality_p99", 0.0),
     )
     sampler = None
     try:
@@ -319,6 +326,10 @@ def main(argv=None) -> int:
     parser.add_argument("--max-loop-lag", type=float, default=0.25,
                         help="loop-lag p99 (s) past which a node shows "
                         "yellow on the readiness gate (0 disables)")
+    parser.add_argument("--max-finality-p99", type=float, default=0.0,
+                        help="submit→finalized p99 (s) past which a node "
+                        "shows yellow on the readiness gate (0 disables; "
+                        "reads mysticeti_e2e_finality_p99_seconds)")
     parser.add_argument("--max-ticks", type=int, default=2880,
                         help="keep at most this many timeline ticks in "
                         "memory/on disk (oldest roll off; default = 4h at "

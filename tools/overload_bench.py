@@ -63,6 +63,30 @@ def _parse_ingress(texts) -> dict:
     }
 
 
+_FINALITY_GAUGES = {
+    "mysticeti_e2e_finality_p50_seconds": "server_p50_s",
+    "mysticeti_e2e_finality_p99_seconds": "server_p99_s",
+    "mysticeti_client_finality_p50_seconds": "client_p50_s",
+    "mysticeti_client_finality_p99_seconds": "client_p99_s",
+}
+
+
+def _parse_finality(texts) -> dict:
+    """Worst-node finality percentiles (server e2e + client-observed) from
+    the fleet's raw /metrics scrapes — the per-rung finality columns."""
+    from mysticeti_tpu.orchestrator.measurement import iter_series
+
+    out = {key: 0.0 for key in _FINALITY_GAUGES.values()}
+    for text in texts:
+        if text is None:
+            continue
+        for name, _labels, value in iter_series(text):
+            key = _FINALITY_GAUGES.get(name)
+            if key is not None:
+                out[key] = max(out[key], value)
+    return {k: round(v, 4) for k, v in out.items()}
+
+
 async def run_rung(nodes: int, load: int, duration: float, workdir: str,
                    label: str) -> dict:
     """One fixed-offered-load fleet run; returns the rung record."""
@@ -109,6 +133,7 @@ async def run_rung(nodes: int, load: int, duration: float, workdir: str,
         "window_utc": [round(started, 1), round(time.time(), 1)],
     }
     rung.update(_parse_ingress(texts))
+    rung["finality"] = _parse_finality(texts)
     health = cluster_snapshot_from_texts(
         {f"node-{a}": texts[a] for a in range(nodes)}, nodes
     )
@@ -153,6 +178,10 @@ def run_determinism_leg() -> dict:
         "shed_schedule_digest_run1": r3a.shed_schedule_digest,
         "shed_schedule_digest_run2": r3b.shed_schedule_digest,
         "byte_identical": r3a.shed_log_bytes == r3b.shed_log_bytes,
+        # Client-perceived finality under 3x overload (finality.py): the
+        # sim's closed-loop generators vs the server-side trackers.
+        "sim_server_finality_3x": r3a.server_finality,
+        "sim_client_finality_3x": r3a.client_finality,
     }
 
 
