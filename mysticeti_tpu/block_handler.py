@@ -367,6 +367,14 @@ class TestBlockHandler(BlockHandler):
         self.authority = authority
         self.proposed: List[TransactionLocator] = []
         self.metrics = metrics
+        # Out-of-band payloads (e.g. reconfig committee-change transactions,
+        # reconfig.py) planted by a harness; drained ahead of the generated
+        # counter transaction on the next proposal.
+        self.pending_inject: Deque[bytes] = deque()
+
+    def inject(self, payload: bytes) -> None:
+        """Queue an arbitrary transaction payload for the next own proposal."""
+        self.pending_inject.append(payload)
 
     def is_certified(self, locator: TransactionLocator) -> bool:
         return self.transaction_votes.is_processed(locator)
@@ -385,6 +393,8 @@ class TestBlockHandler(BlockHandler):
                     for st in block.statements:
                         if isinstance(st, Share):
                             self.last_transaction += 1
+            while self.pending_inject:
+                response.append(Share(self.pending_inject.popleft()))
             self.last_transaction += 1
             response.append(Share(self.make_transaction(self.last_transaction)))
         for block in blocks:

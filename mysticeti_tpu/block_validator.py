@@ -69,6 +69,14 @@ class BlockVerifier:
                 out.append(False)
         return out
 
+    def note_committee(self, committee: "Committee") -> None:
+        """Epoch reconfiguration hook (reconfig.py): the committee's stake
+        table changed at a boundary commit.  Registry KEYS are stable
+        (stable-index membership), so signature tables need no rebuild —
+        only stake-weighted math (quorum endorsement) must follow the new
+        committee.  Default: nothing stake-weighted here."""
+        return None
+
 
 class AcceptAllBlockVerifier(BlockVerifier):
     """block_validator.rs:18-27."""
@@ -1052,6 +1060,13 @@ class ThresholdAggregateVerifier(BlockVerifier):
             blocks, self.committee, self.inner.verify_blocks, self._count
         )
 
+    def note_committee(self, committee: Committee) -> None:
+        """Quorum endorsement is stake-weighted: follow the epoch's stakes."""
+        self.committee = committee
+        note = getattr(self.inner, "note_committee", None)
+        if note is not None:
+            note(committee)
+
 
 def _observe_orphan(fut) -> None:
     """Retrieve an orphaned executor future's exception so a backend crash
@@ -1177,6 +1192,13 @@ class BatchedSignatureVerifier(BlockVerifier):
     # to discard — but it must not drag the EMA so far that a resuming
     # burst needs minutes of samples to recover the window.
     ARRIVAL_GAP_CAP_S = 1.0
+
+    def note_committee(self, committee: Committee) -> None:
+        """Epoch switch (reconfig.py): rebind the stake table.  Key tables
+        (TpuSignatureVerifier's KeyTable) are indexed by the stable registry
+        and need no rebuild; only the quorum-endorsement stake math and
+        per-author key lookups follow the new committee object."""
+        self.committee = committee
 
     def _pipeline_fixed_cost(self) -> float:
         """Fixed dispatch cost estimate for the adaptive pipeline depth: the

@@ -316,6 +316,12 @@ class SafetyChecker:
         # still raises: that is the safety property under attack.
         self.adversaries: Set[int] = set()
         self.adversary_divergence: List[dict] = []
+        # Epoch reconfiguration (reconfig.py): per-authority epoch records
+        # — epoch -> (boundary_height, digest).  All honest nodes that
+        # cross a boundary must derive the SAME boundary for the same
+        # epoch; a disagreement is a safety violation of the same class as
+        # a commit fork (the committees diverge, then everything does).
+        self._epochs: Dict[int, Dict[int, Tuple[int, bytes]]] = {}
         # Committed-throughput accounting: transactions (Share statements)
         # AND blocks in each node's committed sub-dags, keyed observer ->
         # block author, counted once per height (a WAL-replay
@@ -361,6 +367,36 @@ class SafetyChecker:
                     self._violation = violation
                 raise violation
             mine[height] = leader
+
+    def note_epoch(self, authority: int, records) -> None:
+        """Record epoch boundaries an authority derived (EpochRecord list
+        from a switch, a recovery re-scan, or a snapshot chain adoption).
+        A node re-deriving a DIFFERENT boundary for an epoch it already
+        crossed — e.g. before and after a crash — raises immediately."""
+        mine = self._epochs.setdefault(authority, {})
+        for rec in records:
+            entry = (rec.boundary_height, bytes(rec.digest))
+            prev = mine.get(rec.epoch)
+            if prev is not None and prev != entry:
+                if authority in self.adversaries:
+                    self._note_adversary_divergence(
+                        kind="epoch-self-conflict", adversary=authority,
+                        epoch=rec.epoch,
+                    )
+                    mine[rec.epoch] = entry
+                    continue
+                violation = SafetyViolation(
+                    f"authority {authority} derived epoch {rec.epoch} twice "
+                    f"with different boundaries: {prev!r} then {entry!r}"
+                )
+                if self._violation is None:
+                    self._violation = violation
+                raise violation
+            mine[rec.epoch] = entry
+
+    def epoch_of(self, authority: int) -> int:
+        mine = self._epochs.get(authority)
+        return max(mine) if mine else 0
 
     def observe(self, authority: int, committed) -> None:
         """Record a node's freshly committed sub-dags (List[CommittedSubDag])."""
@@ -462,6 +498,30 @@ class SafetyChecker:
                     self._note_adversary_divergence(
                         kind="fork", adversary=authority, height=height,
                     )
+        # Epoch-boundary agreement (reconfig.py): every honest node that
+        # crossed epoch E derived the same (boundary height, committee
+        # digest) — prefix consistency extended across reconfigurations.
+        golden_epochs: Dict[int, Tuple[Tuple[int, bytes], int]] = {}
+        for authority in sorted(self._epochs):
+            if authority in self.adversaries:
+                continue
+            for epoch, entry in self._epochs[authority].items():
+                prev = golden_epochs.get(epoch)
+                if prev is None:
+                    golden_epochs[epoch] = (entry, authority)
+                elif prev[0] != entry:
+                    raise SafetyViolation(
+                        f"epoch fork at epoch {epoch}: authority {prev[1]} "
+                        f"derived {prev[0]!r}, authority {authority} "
+                        f"derived {entry!r}"
+                    )
+        for authority in sorted(self.adversaries & set(self._epochs)):
+            for epoch, entry in self._epochs[authority].items():
+                prev = golden_epochs.get(epoch)
+                if prev is not None and prev[0] != entry:
+                    self._note_adversary_divergence(
+                        kind="epoch-fork", adversary=authority, epoch=epoch,
+                    )
 
 
 class _CheckedCommitObserver(TestCommitObserver):
@@ -523,9 +583,18 @@ class ChaosSimHarness:
         per_node_parameters: Optional[Dict[int, Parameters]] = None,
         latency_ranges=None,
         adversaries: Optional[Set[int]] = None,
+        absent: Optional[Set[int]] = None,
     ) -> None:
         self.n = n
         self.wal_dir = wal_dir
+        # Epoch reconfiguration (reconfig.py): ``absent`` authorities are
+        # registered in the committee (stable-index membership) but not
+        # BUILT at start — :meth:`join` boots one mid-run, typically after
+        # a committed ADD change activated its stake; ``retired`` tracks
+        # clean departures (:meth:`retire`) so the health plane never
+        # flags them as stragglers.
+        self.absent: Set[int] = set(absent or ())
+        self.retired: Set[int] = set()
         self.committee = committee or Committee.new_test([1] * n)
         self.signers = Committee.benchmark_signers(n)
         self.parameters = parameters or Parameters(leader_timeout_s=1.0)
@@ -650,15 +719,36 @@ class ChaosSimHarness:
                 block_verifier=verifier,
                 commit_observer=observer,
             )
+        if core.reconfig is not None:
+            # Feed the epoch audit: boundaries already re-derived by this
+            # boot (recovery re-scan / checkpoint chain), then every future
+            # switch via the listener.
+            if core.reconfig.chain.records:
+                self.checker.note_epoch(authority, core.reconfig.chain.records)
+            core.epoch_listeners.append(
+                lambda committee, records, a=authority: self.checker.note_epoch(
+                    a, records
+                )
+            )
         return node
 
     async def start(self) -> None:
         for authority in range(self.n):
+            if authority in self.absent:
+                self.down.add(authority)
+                continue
             node = self._build_node(authority)
             self.nodes[authority] = node
             await node.start()
         await self.sim_net.connect_all()
+        for authority in sorted(self.absent):
+            # Links to an absent node are severed immediately (peers see
+            # closure, exactly like a pre-start crash); join() restores
+            # them through the ordinary restart path.
+            self.sim_net.crash(authority)
         if self.health_monitor is not None:
+            for authority in self.absent:
+                self.health_monitor.note_retired(authority)
             self.health_monitor.start()
 
     async def crash(self, authority: int, torn_tail_bytes: int = 0) -> None:
@@ -699,6 +789,55 @@ class ChaosSimHarness:
         self.down.discard(authority)
         await self.sim_net.restart(authority)
         return node
+
+    # -- epoch reconfiguration (reconfig.py) --
+
+    async def join(self, authority: int) -> NetworkSyncer:
+        """First boot of an ``absent`` authority mid-run: a fresh epoch-0
+        start from an empty WAL.  The joiner discovers the current
+        committee by replaying the committed sequence — or, far behind,
+        by adopting a snapshot manifest whose epoch chain carries every
+        boundary it slept through."""
+        assert authority in self.absent, f"authority {authority} not absent"
+        self.recorders[authority].record("join")
+        node = self._build_node(authority)
+        self.nodes[authority] = node
+        await node.start()
+        self.down.discard(authority)
+        self.absent.discard(authority)
+        if self.health_monitor is not None:
+            self.health_monitor.note_joined(authority)
+        await self.sim_net.restart(authority)
+        return node
+
+    async def retire(self, authority: int) -> None:
+        """Clean departure (a committed REMOVE change): stop the node and
+        keep it gone.  Deliberately NOT a crash — no crash event is
+        recorded, the health plane marks the authority retired (not down),
+        and no restart ever follows."""
+        node = self.nodes[authority]
+        assert node is not None, f"authority {authority} is already down"
+        self.retired.add(authority)
+        self.down.add(authority)
+        self.recorders[authority].record("retire")
+        probe = self.probes.get(authority)
+        if probe is not None:
+            probe.detach()
+        if self.health_monitor is not None:
+            self.health_monitor.note_retired(authority)
+        self.sim_net.crash(authority)
+        await node.stop()
+        node.core.wal_writer.close()
+        node.core.block_store.close()
+        self.nodes[authority] = None
+
+    def submit_change(self, via: int, change) -> None:
+        """Plant a committee-change transaction on ``via``'s block handler:
+        it rides the next own proposal as an ordinary Share and takes
+        effect when the committed sequence orders it."""
+        node = self.nodes[via]
+        assert node is not None, f"authority {via} is down"
+        node.core.block_handler.inject(change.to_bytes())
 
     async def stop(self) -> None:
         if self.health_monitor is not None:
@@ -947,6 +1086,12 @@ class ChaosReport:
     # also reflect the test generator's batch-shaped minting).
     committed_tx: Dict[int, Dict[int, int]] = field(default_factory=dict)
     committed_blocks: Dict[int, Dict[int, int]] = field(default_factory=dict)
+    # Epoch reconfiguration: the final epoch each authority reached, and
+    # the audited boundary table (epoch -> [boundary_height, digest hex])
+    # agreed by the honest fleet — empty when the scenario never
+    # reconfigured.
+    epochs: Dict[int, int] = field(default_factory=dict)
+    epoch_boundaries: Dict[int, List] = field(default_factory=dict)
 
     @staticmethod
     def _from_authors(
@@ -1034,6 +1179,7 @@ def run_chaos_sim(
     latency_ranges=None,
     committee: Optional[Committee] = None,
     detsan=None,
+    absent: Optional[Set[int]] = None,
 ) -> Tuple[ChaosReport, ChaosSimHarness]:
     """Run one chaos scenario to completion on a fresh DeterministicLoop.
 
@@ -1076,6 +1222,7 @@ def run_chaos_sim(
         per_node_parameters=per_node_parameters,
         latency_ranges=latency_ranges,
         adversaries={spec.node for spec in plan.adversaries},
+        absent=absent,
     )
     engine = ChaosEngine(harness, plan)
 
@@ -1140,6 +1287,16 @@ def run_chaos_sim(
                 observer: dict(by_author)
                 for observer, by_author in
                 harness.checker.committed_blocks.items()
+            },
+            epochs={
+                a: harness.checker.epoch_of(a)
+                for a in range(harness.n)
+                if harness.checker.epoch_of(a) > 0
+            },
+            epoch_boundaries={
+                epoch: [height, digest.hex()]
+                for table in harness.checker._epochs.values()
+                for epoch, (height, digest) in table.items()
             },
         )
 

@@ -69,16 +69,29 @@ class Committee:
         authorities: Sequence[Authority],
         epoch: Epoch = 0,
         leader_election: str = STAKE_WEIGHTED,
+        epoch_tolerant: bool = False,
     ) -> None:
         if not authorities:
             raise ValueError("committee must not be empty")
         if len(authorities) > MAX_COMMITTEE_SIZE:
             raise ValueError(f"committee larger than {MAX_COMMITTEE_SIZE}")
-        if any(a.stake <= 0 for a in authorities):
-            raise ValueError("all stakes must be positive")
+        if any(a.stake < 0 for a in authorities):
+            raise ValueError("stakes must be non-negative")
+        # Stable-index membership (reconfig.py): stake 0 marks a registered
+        # but INACTIVE authority — it keeps its index, key, and genesis block
+        # but contributes nothing to thresholds and is unelectable.  At
+        # least one member must be active or no quorum exists at all.
+        if all(a.stake == 0 for a in authorities):
+            raise ValueError("at least one authority must have positive stake")
         self.authorities: Tuple[Authority, ...] = tuple(authorities)
         self.epoch = epoch
         self.leader_election = leader_election
+        # Epoch-tolerant committees accept blocks stamped with OTHER epoch
+        # numbers (reconfiguration: honest peers straddle a boundary for a
+        # few rounds, and a rejoiner catches up through older epochs' blocks).
+        # Signatures still bind blocks to this registry's keys, so tolerance
+        # never admits another deployment's blocks.
+        self.epoch_tolerant = epoch_tolerant
         self.total_stake: Stake = sum(a.stake for a in authorities)
         # is_valid: amount > total/3 ; is_quorum: amount > 2*total/3 (committee.rs:56-57,120-127)
         self._validity_floor = self.total_stake // 3
@@ -95,12 +108,43 @@ class Committee:
         )
 
     @classmethod
-    def new_for_benchmarks(cls, size: int, epoch: Epoch = 0) -> "Committee":
-        """Equal-stake committee with deterministic per-index keys (committee.rs:190-193)."""
+    def new_for_benchmarks(
+        cls,
+        size: int,
+        epoch: Epoch = 0,
+        stakes: Optional[Sequence[Stake]] = None,
+    ) -> "Committee":
+        """Equal-stake committee with deterministic per-index keys
+        (committee.rs:190-193).  ``stakes`` overrides the per-index stakes
+        (churn scenarios register a joiner at stake 0)."""
+        if stakes is not None and len(stakes) != size:
+            raise ValueError("stakes must have one entry per authority")
         return cls(
-            [Authority(1, s.public_key) for s in cls.benchmark_signers(size)],
+            [
+                Authority(1 if stakes is None else stakes[i], s.public_key)
+                for i, s in enumerate(cls.benchmark_signers(size))
+            ],
             epoch,
             leader_election=STAKE_WEIGHTED,
+        )
+
+    def with_stakes(
+        self, stakes: Sequence[Stake], epoch: Epoch
+    ) -> "Committee":
+        """Derive another epoch's committee over the SAME registry: keys,
+        hostnames, and election strategy carry over; only stakes and the
+        epoch number change.  Derived committees are epoch-tolerant (their
+        whole point is to live through a boundary)."""
+        if len(stakes) != len(self.authorities):
+            raise ValueError("stakes must have one entry per authority")
+        return Committee(
+            [
+                Authority(stake, a.public_key, a.hostname)
+                for stake, a in zip(stakes, self.authorities)
+            ],
+            epoch,
+            leader_election=self.leader_election,
+            epoch_tolerant=True,
         )
 
     @staticmethod
@@ -179,6 +223,28 @@ class Committee:
 
     def known_authority(self, authority: AuthorityIndex) -> bool:
         return 0 <= authority < len(self.authorities)
+
+    def accepts_epoch(self, epoch: Epoch) -> bool:
+        """Block-verification epoch gate (types.verify_structure): exact
+        match by default; epoch-tolerant committees (reconfiguration) accept
+        any epoch number — keys are stable across stake changes, so the
+        signature check still rejects foreign blocks."""
+        return epoch == self.epoch or self.epoch_tolerant
+
+    def is_active(self, authority: AuthorityIndex) -> bool:
+        """Positive stake == active member of this epoch (stable-index
+        membership: stake 0 marks a registered-but-retired/not-yet-joined
+        authority)."""
+        return (
+            self.known_authority(authority)
+            and self.authorities[authority].stake > 0
+        )
+
+    def active_authorities(self) -> List[AuthorityIndex]:
+        return [i for i, a in enumerate(self.authorities) if a.stake > 0]
+
+    def active_count(self) -> int:
+        return sum(1 for a in self.authorities if a.stake > 0)
 
     def get_stake(self, authority: AuthorityIndex) -> Stake:
         return self.authorities[authority].stake

@@ -196,6 +196,14 @@ class Parameters:
     # Byzantine scenario profile (scenarios.py) arms it at 4 where silent
     # adversaries are declared and the round clock is the sim's own.
     leader_liveness_horizon_rounds: int = 0
+    # Commit-anchored epoch reconfiguration (reconfig.py): committee-change
+    # transactions in the committed sequence derive new epochs; the commit
+    # rule becomes slot-sequential (one decided leader per try_commit batch)
+    # so every node switches stake arithmetic at the same sequence point,
+    # and the EpochInfo wire extension (tag 17, docs/wire-format.md §8) is
+    # armed.  Off by default: pre-knob peers reset connections on the soft
+    # tag, and the frozen-committee fast path skips the per-commit scan.
+    reconfig: bool = False
     # Legacy spellings of the storage block's knobs: accepted at construction
     # and in YAML for back-compat, migrated into ``storage`` by __post_init__
     # (which then rebinds these names to the storage block's values, so every

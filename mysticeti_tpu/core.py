@@ -100,6 +100,20 @@ class Core:
         threshold_clock = ThresholdClockAggregator(0, metrics)
         writer = BlockWriter(wal_writer, block_store)
 
+        # Commit-anchored reconfiguration (reconfig.py): the committee given
+        # here is the epoch-0 genesis REGISTRY; a recovered epoch chain
+        # (checkpoint/snapshot soft tail) re-derives the current epoch's
+        # committee before anything below touches stake arithmetic.
+        self.reconfig = None
+        if parameters.reconfig:
+            from .reconfig import EpochChain, ReconfigState
+
+            committee.epoch_tolerant = True
+            self.reconfig = ReconfigState(
+                committee, EpochChain.from_bytes(recovered.epoch_chain)
+            )
+            committee = self.reconfig.committee
+
         if recovered.last_own_block is not None:
             # Recovery: replay pending includes into the clock (core.rs:89-95).
             for _, meta in pending:
@@ -159,18 +173,100 @@ class Core:
         # metric counts skipped SLOTS, not readiness polls).
         self._leader_skip_marked: Dict[AuthorityIndex, RoundNumber] = {}
         self.storage = storage
-        self.committer: UniversalCommitter = (
-            UniversalCommitterBuilder(committee, block_store, metrics)
-            .with_wave_length(parameters.wave_length)
-            .with_number_of_leaders(parameters.number_of_leaders)
-            .with_pipeline(parameters.enable_pipelining)
-            .build()
-        )
+        self.parameters = parameters
+        # Called on every epoch switch with (new_committee, records): the
+        # sync layer re-derives peer/relay/verifier state, the chaos checker
+        # audits cross-node boundary agreement.  Registered post-construction
+        # by the node assembly; fired on the consensus owner only.
+        self.epoch_listeners: List = []
+        # Historical-committee memo for committee_for_epoch (catch-up
+        # validates every pre-boundary block against its own epoch).
+        self._epoch_committees: Dict[int, Committee] = {}
+        self.committer: UniversalCommitter = self._build_committer()
+
+        if self.reconfig is not None:
+            # Crash landing between a boundary commit's WAL entry and the
+            # next checkpoint: the replayed commits (everything after the
+            # checkpoint baseline) are re-scanned so the node re-derives the
+            # exact epoch it crashed out of.
+            for commit in recovered.recovered_commits:
+                blocks = [
+                    b
+                    for b in (
+                        block_store.get_block(ref) for ref in commit.sub_dag
+                    )
+                    if b is not None
+                ]
+                transition = self.reconfig.observe_commit(
+                    commit.height, commit.leader.round, blocks
+                )
+                if transition is not None:
+                    self._switch_epoch(transition)
+            if metrics is not None:
+                metrics.mysticeti_epoch.set(self.committee.epoch)
+                metrics.mysticeti_committee_digest_info.labels(
+                    self.reconfig.digest().hex()[:16]
+                ).set(self.committee.epoch)
 
         if recovered.unprocessed_blocks:
             # Blocks after the last state snapshot re-run through the handler
             # (core.rs:152-158).
             self.run_block_handler(recovered.unprocessed_blocks)
+
+    def _build_committer(self) -> UniversalCommitter:
+        return (
+            UniversalCommitterBuilder(self.committee, self.block_store, self.metrics)
+            .with_wave_length(self.parameters.wave_length)
+            .with_number_of_leaders(self.parameters.number_of_leaders)
+            .with_pipeline(self.parameters.enable_pipelining)
+            .build()
+        )
+
+    def _switch_epoch(self, transition) -> None:
+        """Apply an epoch transition on the consensus owner: swap the
+        committee every stake/quorum computation reads, rebuild the commit
+        rule over it, and notify the sync/health/verifier listeners.  Called
+        at a deterministic committed-sequence point (observe_commit), so
+        every honest node performs the identical switch."""
+        self.committee = transition.committee
+        self.committer = self._build_committer()
+        if hasattr(self.block_handler, "committee"):
+            self.block_handler.committee = self.committee
+        for record in transition.records:
+            log.info(
+                "epoch %d: boundary height=%d round=%d digest=%s stakes=%s",
+                record.epoch, record.boundary_height, record.boundary_round,
+                record.digest.hex()[:16], list(record.stakes),
+            )
+        if self.metrics is not None:
+            self.metrics.mysticeti_epoch.set(self.committee.epoch)
+            self.metrics.mysticeti_epoch_transitions_total.inc(
+                len(transition.records)
+            )
+            self.metrics.mysticeti_committee_digest_info.labels(
+                transition.records[-1].digest.hex()[:16]
+            ).set(self.committee.epoch)
+        for listener in self.epoch_listeners:
+            listener(self.committee, transition.records)
+
+    def committee_for_epoch(self, epoch: int) -> Committee:
+        """Structural-validation committee for a block stamped ``epoch``.
+
+        A historical block's threshold clock must be judged by ITS epoch's
+        stake arithmetic — catch-up replays pre-boundary rounds long after
+        the switch, and those include sets were built against the old
+        quorum.  Epochs this node has not derived (including claimed
+        future ones) fall back to the CURRENT committee: an author cannot
+        buy lenient validation by stamping an epoch nobody has reached."""
+        if self.reconfig is None or epoch == self.committee.epoch:
+            return self.committee
+        cached = self._epoch_committees.get(epoch)
+        if cached is None:
+            cached = self.reconfig.committee_for_epoch(epoch)
+            if cached is None:
+                return self.committee
+            self._epoch_committees[epoch] = cached
+        return cached
 
     # -- ingestion (core.rs:171-207) --
 
@@ -336,6 +432,19 @@ class Core:
 
     def try_commit(self) -> List[StatementBlock]:
         sequence = self.committer.try_commit(self.last_decided_leader)
+        if self.reconfig is not None and sequence:
+            # Slot-sequential commit under reconfiguration: cap each batch at
+            # the FIRST committed leader.  A change transaction anywhere in
+            # that commit's sub-dag switches the committee, and every later
+            # slot must be decided under the post-switch stake arithmetic —
+            # a node that decided a whole multi-leader batch with the old
+            # committee while a slower peer split it across the boundary
+            # would diverge.  The syncer loops until a pass decides nothing,
+            # so throughput is unchanged.
+            for i, status in enumerate(sequence):
+                if status.kind == LeaderStatus.COMMIT:
+                    sequence = sequence[: i + 1]
+                    break
         if sequence:
             self.last_decided_leader = sequence[-1].into_decided_author_round()
         if self.last_decided_leader.round > self.rounds_in_epoch:
@@ -407,6 +516,17 @@ class Core:
                     height=commit.height,
                 )
             )
+            if self.reconfig is not None:
+                # Scan this commit's sub-dag (in linearized order) for
+                # finalized committee changes; the switch happens HERE —
+                # before the checkpoint below embeds the chain, and before
+                # any later slot is decided (try_commit is slot-sequential
+                # under reconfig, so `committed` holds at most one commit).
+                transition = self.reconfig.observe_commit(
+                    commit.height, commit.anchor.round, commit.blocks
+                )
+                if transition is not None:
+                    self._switch_epoch(transition)
         self.write_state()
         self.write_commits(commit_data, state)
         if self.storage is not None and commit_data:
@@ -454,6 +574,14 @@ class Core:
         # never process; the handler's oracles must expect their votes.
         self.block_handler.note_catchup(self.storage.retired_round)
         self._raise_dag_floor(self.storage.retired_round)
+        if self.reconfig is not None and manifest.epoch_chain:
+            # Cross-boundary catch-up: the manifest's epoch chain is the
+            # rejoiner's only source for boundaries it slept through — adopt
+            # it and switch onto the CURRENT committee before processing the
+            # post-baseline block stream.
+            transition = self.reconfig.adopt_chain(manifest.epoch_chain)
+            if transition is not None:
+                self._switch_epoch(transition)
         return True
 
     def _raise_dag_floor(self, floor: RoundNumber) -> None:
@@ -497,7 +625,12 @@ class Core:
             peer_height
         ):
             return None
-        return self.storage.build_manifest()
+        manifest = self.storage.build_manifest()
+        if self.reconfig is not None:
+            # The epoch chain rides the manifest so a rejoiner absent across
+            # boundaries lands on the CURRENT committee, not the genesis one.
+            manifest.epoch_chain = self.reconfig.chain.to_bytes()
+        return manifest
 
     def wal_syncer(self) -> WalSyncer:
         return self.wal_writer.syncer()

@@ -39,7 +39,7 @@ from __future__ import annotations
 import asyncio
 import json
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .runtime import now as runtime_now
 from .spans import PIPELINE_STAGES
@@ -375,12 +375,23 @@ class HealthProbe:
         self._last_round = round_
         self._last_commit_height = commit_height
 
-        # Frontier: own round vs what each peer has shown us.
+        # Frontier: own round vs what each peer has shown us.  Under epoch
+        # reconfiguration (reconfig.py) an INACTIVE authority — cleanly
+        # departed, or registered-at-genesis but not yet activated — is
+        # retired, not a straggler: it produces no blocks by design, so it
+        # is excluded from the lag table (no participation alerts) and
+        # listed separately.  With reconfig off every authority has
+        # positive stake and nothing changes.
         lags: Dict[int, int] = {}
+        retired: List[int] = []
         max_peer_round = round_
         store = core.block_store
+        committee = getattr(core, "committee", None)
         for a in range(self.committee_size):
             if a == self.authority:
+                continue
+            if committee is not None and not committee.is_active(a):
+                retired.append(a)
                 continue
             seen = store.last_seen_by_authority(a)
             lags[a] = max(0, round_ - seen)
@@ -437,6 +448,13 @@ class HealthProbe:
             "breaker_open_fraction": round(breaker_fraction, 6),
             "wal_backlog": wal_backlog,
         }
+        if getattr(core, "reconfig", None) is not None:
+            # Reconfig-only keys, so pre-reconfig timelines stay
+            # byte-identical: the node's current epoch plus the retired
+            # (zero-stake) authorities excluded from the lag table above.
+            snapshot["epoch"] = core.committee.epoch
+            if retired:
+                snapshot["retired_authorities"] = retired
         if verifier_state is not None:
             snapshot["verifier"] = verifier_state
         if self._ingress is not None:
@@ -661,13 +679,26 @@ class FleetHealthMonitor:
         self.interval_s = interval_s
         self.timeline: List[dict] = []
         self._task: Optional[asyncio.Task] = None
+        # Epoch reconfiguration: authorities that departed CLEANLY (or have
+        # not activated yet) are "retired", not "down" — expected absence,
+        # never a degraded-fleet signal.
+        self.retired: Set[int] = set()
+
+    def note_retired(self, authority: int) -> None:
+        self.retired.add(authority)
+
+    def note_joined(self, authority: int) -> None:
+        self.retired.discard(authority)
 
     def tick(self) -> dict:
         nodes: Dict[str, dict] = {}
         for authority in range(self.n):
             probe = self.probe_of(authority)
             if probe is None or not probe.attached:
-                nodes[str(authority)] = {"down": True}
+                if authority in self.retired:
+                    nodes[str(authority)] = {"retired": True}
+                else:
+                    nodes[str(authority)] = {"down": True}
                 continue
             snapshot = dict(probe.sample())
             for key in VOLATILE_KEYS:
@@ -770,12 +801,15 @@ def node_health_from_series(series) -> dict:
         "finality_p50_s": 0.0,
         "finality_p99_s": 0.0,
         "cpu_subsystems": {},
+        "epoch": 0,
     }
     for name, labels, value in series:
         if name == "threshold_clock_round":
             out["round"] = int(value)
         elif name == "commit_round":
             out["commit_round"] = int(value)
+        elif name == "mysticeti_epoch":
+            out["epoch"] = int(value)
         elif name == "mysticeti_health_commit_rate":
             out["commit_rate"] = value
         elif name == "mysticeti_health_round_advance_rate":
@@ -815,6 +849,7 @@ def cluster_snapshot(
     nodes: Dict[str, Optional[dict]],
     committee_size: int,
     slo: Optional[SLOThresholds] = None,
+    retired: Optional[Set[str]] = None,
 ) -> dict:
     """Fleet-level health for one scrape tick.
 
@@ -823,7 +858,15 @@ def cluster_snapshot(
     blocks reached ANY committed sub-dag; the straggler score per authority
     is the worst frontier lag any node reports for it; cross-node commit
     skew is the spread of committed rounds across the fleet.
+
+    ``retired`` names authorities that departed the committee CLEANLY
+    (epoch reconfiguration): they are expected-absent, never counted
+    unreachable, and ``committee_size`` should already be the CURRENT
+    epoch's active count so quorum participation is judged against the
+    committee that actually votes.
     """
+    retired = retired or set()
+    nodes = {k: v for k, v in nodes.items() if k not in retired}
     reachable = {k: v for k, v in nodes.items() if v is not None}
     commit_rounds = [v["commit_round"] for v in reachable.values()]
     committed_authorities = set()
@@ -834,15 +877,22 @@ def cluster_snapshot(
             if count > 0:
                 committed_authorities.add(a)
         for a, lag in v["authority_lag_rounds"].items():
+            if a in retired:
+                continue  # frozen gauge from before the departure
             stragglers[a] = max(stragglers.get(a, 0), lag)
         for kind, count in v["slo_alerts"].items():
             alert_totals[kind] = alert_totals.get(kind, 0.0) + count
+    committed_authorities -= set(retired)
     participation = (
         len(committed_authorities) / committee_size if committee_size else 0.0
     )
     snapshot = {
         "reachable": sorted(reachable),
         "unreachable": sorted(k for k, v in nodes.items() if v is None),
+        "retired": sorted(retired),
+        "epochs_by_node": {
+            k: int(v.get("epoch", 0)) for k, v in sorted(reachable.items())
+        },
         "quorum_participation": round(participation, 4),
         "commit_skew_rounds": (
             max(commit_rounds) - min(commit_rounds) if commit_rounds else 0
@@ -924,6 +974,7 @@ def cluster_snapshot_from_texts(
     texts: Dict[str, Optional[str]],
     committee_size: int,
     slo: Optional[SLOThresholds] = None,
+    retired: Optional[Set[str]] = None,
 ) -> dict:
     """Convenience: per-node raw ``/metrics`` text (None = unreachable) ->
     :func:`cluster_snapshot`."""
@@ -933,4 +984,4 @@ def cluster_snapshot_from_texts(
         k: None if text is None else node_health_from_series(iter_series(text))
         for k, text in texts.items()
     }
-    return cluster_snapshot(nodes, committee_size, slo=slo)
+    return cluster_snapshot(nodes, committee_size, slo=slo, retired=retired)

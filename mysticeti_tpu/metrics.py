@@ -203,6 +203,24 @@ class Metrics:
             "(recovery replays only WAL entries after it)",
         )
 
+        # Epoch reconfiguration (reconfig.py).
+        self.mysticeti_epoch = gauge(
+            "mysticeti_epoch",
+            "current consensus epoch (advances when a committed "
+            "committee-change transaction derives a new committee)",
+        )
+        self.mysticeti_epoch_transitions_total = counter(
+            "mysticeti_epoch_transitions_total",
+            "epoch boundaries crossed since boot (commit-anchored committee "
+            "switches, including those re-derived on recovery)",
+        )
+        self.mysticeti_committee_digest_info = gauge(
+            "mysticeti_committee_digest_info",
+            "info gauge naming the active committee: value is the epoch, "
+            "label carries the committee digest prefix",
+            labels=("digest",),
+        )
+
         # Core owner queue (core_lock_* in metrics.rs:51-53; the dispatcher
         # queue is this framework's core lock).
         self.core_lock_enqueued = counter(

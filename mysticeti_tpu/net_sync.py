@@ -31,6 +31,7 @@ from .network import (
     BlockNotFound,
     Blocks,
     Connection,
+    EpochInfo,
     RequestBlocks,
     RequestBlocksResponse,
     RequestSnapshot,
@@ -182,6 +183,12 @@ class NetworkSyncer:
         # timeouts, and sync decisions are exactly the "seconds before the
         # incident" events its ring exists for.  None = not recording.
         self.recorder = recorder
+        # Epoch reconfiguration (reconfig.py): last epoch each peer reported
+        # over the tag-17 extension, plus the listener that re-derives the
+        # relay/peer bookkeeping and re-broadcasts EpochInfo on a switch.
+        self.peer_epochs: Dict[int, int] = {}
+        if getattr(core, "reconfig", None) is not None:
+            core.epoch_listeners.append(self._on_epoch_switch)
 
     def _record(self, kind: str, **fields) -> None:
         if self.recorder is not None:
@@ -301,6 +308,13 @@ class NetworkSyncer:
         # A direct stream from this authority makes any relay of its blocks
         # redundant; forgetting the ask lets a later outage re-request.
         self._helper_subs.drop_authority(peer)
+        if self.parameters.reconfig and self.core.reconfig is not None:
+            # Tag-17 soft extension: advertise our epoch + committee digest
+            # right after the fixed hello (version-skew safe — only sent
+            # when the knob is on, and advisory on the receiving side).
+            await connection.send(
+                EpochInfo(self.core.committee.epoch, self.core.reconfig.digest())
+            )
         if self.parameters.synchronizer.disseminate_others_blocks:
             await self._request_helper_streams(connection)
         if self.parameters.storage.snapshot_catchup:
@@ -445,6 +459,22 @@ class NetworkSyncer:
                         snapshot_armed_floor = None
                 elif isinstance(msg, SnapshotResponse):
                     await self._handle_snapshot_response(connection, msg)
+                elif isinstance(msg, EpochInfo):
+                    # Advisory (tag 17): a skewed peer is probably mid-
+                    # boundary — never a reason to sever; the committed
+                    # sequence itself converges the fleet.
+                    self.peer_epochs[peer] = msg.epoch
+                    local_epoch = self.core.committee.epoch
+                    if msg.epoch != local_epoch:
+                        log.warning(
+                            "authority %d reports epoch %d (local epoch %d);"
+                            " transient skew expected around a boundary",
+                            peer, msg.epoch, local_epoch,
+                        )
+                        self._record(
+                            "epoch-skew", peer=peer, peer_epoch=msg.epoch,
+                            local_epoch=local_epoch,
+                        )
                 elif isinstance(msg, RequestBlocks):
                     if self.metrics is not None:
                         self.metrics.block_sync_requests_received.labels(
@@ -536,9 +566,42 @@ class NetworkSyncer:
             )
             await connection.send(RequestSnapshotStream(manifest.gc_round))
 
+    def _on_epoch_switch(self, committee, records) -> None:
+        """Epoch listener (core.epoch_listeners): runs on the consensus
+        owner right after a boundary commit switched the committee.
+        Sync-only — retire relay bookkeeping for departed authorities,
+        refresh the signature verifier's key view, and re-broadcast our
+        new coordinates.  Live connections to departed peers are NOT
+        severed: in-flight catch-up streams finish naturally."""
+        for authority in range(len(committee)):
+            if authority == self.core.authority:
+                continue
+            if not committee.is_active(authority):
+                # A departed authority needs no relays (its blocks are
+                # settled history) and must not serve as one of ours.
+                self._helper_subs.drop_authority(authority)
+                self._helper_subs.drop_helper(authority)
+            elif self.parameters.synchronizer.disseminate_others_blocks:
+                # A JOINING authority we cannot reach directly yet gets
+                # relays immediately — its first own blocks matter (they
+                # un-stall its leader slots under the new stake table).
+                live = self.connections.get(authority)
+                if live is None or live.is_closed():
+                    self._ask_relays_for(authority)
+        note = getattr(self.block_verifier, "note_committee", None)
+        if note is not None:
+            note(committee)
+        if self.parameters.reconfig and self.core.reconfig is not None:
+            info = EpochInfo(committee.epoch, self.core.reconfig.digest())
+            for conn in list(self.connections.values()):
+                if not conn.is_closed():
+                    conn.try_send(info)
+
     def _ask_relays_for(self, authority: int) -> None:
         """Ask connected peers to relay ``authority``'s blocks (its direct
         connection just dropped), up to maximum_helpers_per_authority."""
+        if not self.core.committee.is_active(authority):
+            return  # departed this epoch: its blocks are settled history
         last_seen = self.core.block_store.last_seen_by_authority(authority)
         for helper, conn in list(self.connections.items()):
             if helper == authority or conn.is_closed():
@@ -556,6 +619,8 @@ class NetworkSyncer:
         for authority in range(len(self.core.committee)):
             if authority in (self.core.authority, connection.peer):
                 continue
+            if not self.core.committee.is_active(authority):
+                continue  # departed this epoch: no relay needed
             live = self.connections.get(authority)
             if live is not None and not live.is_closed():
                 continue
@@ -646,7 +711,13 @@ class NetworkSyncer:
         with timer("net:verify_structure"):
             for block in fresh:
                 try:
-                    block.verify_structure(self.core.committee)
+                    # Epoch-matched structural rules: a pre-boundary block's
+                    # threshold clock is judged by its OWN epoch's quorum
+                    # (committee_for_epoch falls back to the current
+                    # committee outside reconfiguration).
+                    block.verify_structure(
+                        self.core.committee_for_epoch(block.epoch)
+                    )
                 except VerificationError as exc:
                     log.warning("rejecting block %r: %s", block.reference, exc)
                     self._count_invalid(block.author(), "structure")

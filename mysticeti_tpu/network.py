@@ -113,6 +113,12 @@ _MSG_GATEWAY_SUBMIT = 13
 _MSG_GATEWAY_SUBMIT_REPLY = 14
 _MSG_GATEWAY_SUBSCRIBE_COMMITS = 15
 _MSG_GATEWAY_COMMITS = 16
+# Epoch reconfiguration (reconfig.py): the sender's epoch + committee digest,
+# exchanged right after the fixed 12-byte hello and re-broadcast on every
+# epoch switch.  A soft wire extension per docs/wire-format.md §7 (tag 17):
+# only sent when ``Parameters.reconfig`` is on; receivers that predate the
+# tag reset the connection.
+_MSG_EPOCH_INFO = 17
 
 
 @dataclasses.dataclass(frozen=True)
@@ -281,6 +287,18 @@ class GatewayCommitNotification:
 
 
 @dataclasses.dataclass(frozen=True)
+class EpochInfo:
+    """Sender's reconfiguration coordinates (wire tag 17): current epoch and
+    the 32-byte committee digest (reconfig.committee_digest).  Advisory —
+    a mismatch is logged and counted, never a reason to sever (the peer may
+    simply not have processed the boundary commit yet; the committed
+    sequence itself converges the fleet)."""
+
+    epoch: int
+    digest: bytes
+
+
+@dataclasses.dataclass(frozen=True)
 class Ping:
     nanos: int
 
@@ -332,6 +350,8 @@ def encode_message(msg: NetworkMessage) -> bytes:
         w.u8(_MSG_SNAPSHOT).bytes(msg.manifest)
     elif isinstance(msg, RequestSnapshotStream):
         w.u8(_MSG_REQUEST_SNAPSHOT_STREAM).u64(msg.from_round)
+    elif isinstance(msg, EpochInfo):
+        w.u8(_MSG_EPOCH_INFO).u64(msg.epoch).bytes(msg.digest)
     elif isinstance(msg, GatewaySubmit):
         w.u8(_MSG_GATEWAY_SUBMIT).bytes(msg.client).u8(1 if msg.priority else 0)
         w.u32(len(msg.transactions))
@@ -396,6 +416,8 @@ def decode_message(data) -> NetworkMessage:
         msg = SnapshotResponse(bytes(r.bytes()))
     elif tag == _MSG_REQUEST_SNAPSHOT_STREAM:
         msg = RequestSnapshotStream(r.u64())
+    elif tag == _MSG_EPOCH_INFO:
+        msg = EpochInfo(r.u64(), bytes(r.bytes()))
     elif tag == _MSG_BLOCKS_TIMESTAMPED:
         monotonic_ns, wall_ns = r.u64(), r.u64()
         msg = TimestampedBlocks(

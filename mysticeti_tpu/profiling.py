@@ -56,6 +56,8 @@ SUBSYSTEMS: Dict[str, str] = {
     "threshold_clock": "core", "state": "core", "committee": "core",
     "config": "core", "types": "core", "range_map": "core",
     "dag": "core", "lock": "core", "tasks": "core", "epoch_close": "core",
+    # Epoch reconfiguration: the fold runs inline on the core commit path.
+    "reconfig": "core",
     # Commit linearization + interpretation.
     "linearizer": "linearizer", "base_committer": "linearizer",
     "universal_committer": "linearizer", "commit_observer": "linearizer",

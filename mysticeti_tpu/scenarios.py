@@ -31,6 +31,7 @@ seeded runs:
 """
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import hashlib
 import json
@@ -50,6 +51,12 @@ from .chaos import (
 )
 from .committee import Committee
 from .config import Parameters, StorageParameters, SynchronizerParameters
+from .reconfig import (
+    CHANGE_ADD,
+    CHANGE_REMOVE,
+    CHANGE_REWEIGHT,
+    CommitteeChange,
+)
 from .tracing import logger
 
 log = logger(__name__)
@@ -75,6 +82,43 @@ def wan_latency_ranges(
                 WAN_INTRA_RANGE if regions[a] == regions[b] else WAN_INTER_RANGE
             )
     return out
+
+
+@dataclass(frozen=True)
+class ChurnEvent:
+    """One scheduled membership change in a reconfig scenario.
+
+    At ``at_s`` (virtual seconds) a :class:`CommitteeChange` is planted on
+    authority ``via``'s block handler; it rides the committed sequence and
+    takes effect at the commit-anchored epoch boundary every honest node
+    derives from it.  ``follow_delay_s`` later, the harness performs the
+    matching topology act: for ADD, :meth:`ChaosSimHarness.join` boots the
+    (previously absent) authority, which discovers the new committee by
+    snapshot catch-up or replay; for REMOVE, :meth:`ChaosSimHarness.retire`
+    cleanly departs the node — the delay lets the change commit first, so a
+    departing leader keeps its slots live until the boundary retires them.
+    """
+
+    at_s: float
+    kind: int  # CHANGE_ADD / CHANGE_REMOVE / CHANGE_REWEIGHT
+    authority: int
+    stake: int = 0
+    via: int = 0
+    follow_delay_s: float = 2.0
+
+    def to_dict(self) -> dict:
+        return {
+            "at_s": self.at_s,
+            "kind": {
+                CHANGE_ADD: "add",
+                CHANGE_REMOVE: "remove",
+                CHANGE_REWEIGHT: "reweight",
+            }.get(self.kind, str(self.kind)),
+            "authority": self.authority,
+            "stake": self.stake,
+            "via": self.via,
+            "follow_delay_s": self.follow_delay_s,
+        }
 
 
 @dataclass(frozen=True)
@@ -117,6 +161,20 @@ class Scenario:
     # (timestamped frames, helper streams) the rest of the fleet does not —
     # the rolling-upgrade drill.
     new_version_nodes: Tuple[int, ...] = ()
+    # Epoch reconfiguration (reconfig.py): arm Parameters.reconfig, seed the
+    # committee with these genesis stakes (() = all ones; a stake-0 entry is
+    # a registered-but-inactive authority awaiting a committed ADD), keep
+    # ``absent`` authorities unbooted until a churn event joins them, and
+    # drive the ``churn`` schedule in BOTH twins — membership change is part
+    # of the workload, not a fault, so the clean twin churns identically
+    # and the throughput ratio compares like with like.
+    reconfig: bool = False
+    stakes: Tuple[int, ...] = ()
+    absent: Tuple[int, ...] = ()
+    churn: Tuple[ChurnEvent, ...] = ()
+    # Reconfig gate: the honest fleet must reach at least this epoch by the
+    # end of the attacked run (0 = no gate).
+    min_epoch: int = 0
 
     def plan(self) -> FaultPlan:
         return FaultPlan(
@@ -144,6 +202,7 @@ class Scenario:
         )
         return Parameters(
             leader_timeout_s=self.leader_timeout_s,
+            reconfig=self.reconfig,
             # Sim profile: rounds run ~0.1 s, so a 4-round liveness horizon
             # reacts to a silent leader within half a second (the
             # production default of 8 assumes real-network round times).
@@ -186,7 +245,7 @@ class Scenario:
         return None
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "description": self.description,
             "nodes": self.nodes,
@@ -202,6 +261,63 @@ class Scenario:
             "new_version_nodes": list(self.new_version_nodes),
             "plan": self.plan().to_dict(),
         }
+        if self.reconfig:
+            # Emitted only for reconfig scenarios so frozen-committee
+            # verdict documents stay byte-identical.
+            out.update(
+                reconfig=True,
+                stakes=list(self.stakes),
+                absent=list(self.absent),
+                churn=[event.to_dict() for event in self.churn],
+                min_epoch=self.min_epoch,
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Churn driver
+
+
+def _churn_driver(scenario: Scenario):
+    """The continuous-churn schedule as a chaos ``extra_fault`` hook.
+
+    Runs in BOTH twins (membership change is workload, not attack).  All
+    sleeps are virtual time on the :class:`DeterministicLoop`, so the
+    schedule is part of the seeded reproduction recipe and same-seed runs
+    are byte-identical."""
+    events = sorted(scenario.churn, key=lambda e: (e.at_s, e.authority))
+
+    async def driver(harness) -> None:
+        now = 0.0
+        for event in events:
+            if event.at_s > now:
+                await asyncio.sleep(event.at_s - now)
+                now = event.at_s
+            harness.submit_change(
+                event.via,
+                CommitteeChange(
+                    kind=event.kind,
+                    authority=event.authority,
+                    stake=event.stake,
+                ),
+            )
+            if event.follow_delay_s > 0.0:
+                # Let the change ride a proposal and COMMIT before acting on
+                # the topology: an ADDed joiner then catches up across the
+                # boundary it slept through, and a REMOVEd (possibly
+                # leader) node keeps its slots live until the boundary
+                # retires them.
+                await asyncio.sleep(event.follow_delay_s)
+                now += event.follow_delay_s
+            if event.kind == CHANGE_ADD and event.authority in harness.absent:
+                await harness.join(event.authority)
+            elif (
+                event.kind == CHANGE_REMOVE
+                and harness.nodes[event.authority] is not None
+            ):
+                await harness.retire(event.authority)
+
+    return driver
 
 
 # ---------------------------------------------------------------------------
@@ -282,7 +398,9 @@ def run_scenario(
     ``real_crypto`` swaps the sim re-sign oracle for genuine per-node
     Ed25519 verification (same semantics, minutes instead of seconds on
     the pure-Python fallback — the artifact probe's evidence flag)."""
-    committee = Committee.new_for_benchmarks(scenario.nodes)
+    committee = Committee.new_for_benchmarks(
+        scenario.nodes, stakes=list(scenario.stakes) or None
+    )
     kwargs = dict(
         parameters=scenario.base_parameters(),
         per_node_parameters=scenario.per_node_parameters() or None,
@@ -294,7 +412,11 @@ def run_scenario(
             if real_crypto
             else oracle_verifier_factory(scenario.nodes)
         ),
+        absent=set(scenario.absent) or None,
     )
+    # The churn schedule runs in BOTH twins: membership change is part of
+    # the workload, so the clean baseline reconfigures identically.
+    churn = _churn_driver(scenario) if scenario.churn else None
     attacked_dir = os.path.join(wal_root, f"{scenario.name}-attacked")
     clean_dir = os.path.join(wal_root, f"{scenario.name}-clean")
     os.makedirs(attacked_dir, exist_ok=True)
@@ -304,13 +426,13 @@ def run_scenario(
     try:
         report, harness = run_chaos_sim(
             scenario.plan(), scenario.nodes, scenario.duration_s,
-            attacked_dir, **kwargs,
+            attacked_dir, extra_fault=churn, **kwargs,
         )
     except SafetyViolation as exc:
         safety_ok, safety_error = False, str(exc)
     clean_report, _ = run_chaos_sim(
         scenario.clean_plan(), scenario.nodes, scenario.duration_s,
-        clean_dir, **kwargs,
+        clean_dir, extra_fault=churn, **kwargs,
     )
     adversary_nodes = {spec.node for spec in scenario.adversaries}
     honest_nodes = set(range(scenario.nodes)) - adversary_nodes
@@ -328,7 +450,14 @@ def run_scenario(
     # history BY DESIGN, so its observation window is structurally
     # smaller — its verdict is the explicit catch-up gate below plus the
     # SafetyChecker's adopted-prefix audit, not the throughput min.
-    crashed_nodes = {c.node for c in scenario.crashes}
+    # Churned authorities are excluded the same way: a retired node's
+    # committed height freezes at departure and a joiner's observation
+    # window starts late — both structural, both gated explicitly below.
+    crashed_nodes = (
+        {c.node for c in scenario.crashes}
+        | set(scenario.absent)
+        | {e.authority for e in scenario.churn if e.kind == CHANGE_REMOVE}
+    )
 
     def _honest_min(table: Dict[int, int]) -> int:
         return min(
@@ -397,10 +526,39 @@ def run_scenario(
             rejoin["committed_final"] > rejoin["committed_at_crash"]
         )
     rejoins_ok = all(r["caught_up"] for r in rejoins)
+    # Reconfig gate: the honest fleet reached the scheduled epoch (every
+    # boundary's height+digest consistency is the SafetyChecker's job —
+    # an epoch fork raises, failing safety_ok above), and every joiner
+    # actually landed commits on the post-boundary committee.
+    reconfig_ok = True
+    if scenario.reconfig:
+        max_epoch = max(report.epochs.values(), default=0)
+        joiner_commits = {
+            a: harness.checker.committed_height(a)
+            for a in sorted(scenario.absent)
+        }
+        reconfig_ok = max_epoch >= scenario.min_epoch and all(
+            h > 0 for h in joiner_commits.values()
+        )
+        verdict.update(
+            epochs={str(a): e for a, e in sorted(report.epochs.items())},
+            epoch_boundaries={
+                str(e): b for e, b in sorted(report.epoch_boundaries.items())
+            },
+            max_epoch=max_epoch,
+            min_epoch=scenario.min_epoch,
+            joiner_commits={str(a): h for a, h in joiner_commits.items()},
+            clean_epochs={
+                str(a): e
+                for a, e in sorted(clean_report.epochs.items())
+            },
+            reconfig_ok=reconfig_ok,
+        )
     passed = (
         safety_ok
         and detections_ok
         and rejoins_ok
+        and reconfig_ok
         and ratio >= scenario.min_ratio
         and committed > 0
     )
@@ -630,14 +788,138 @@ def default_matrix() -> List[Scenario]:
     ]
 
 
+def reconfig_matrix() -> List[Scenario]:
+    """The continuous-churn scenario family (epoch reconfiguration plane):
+    dynamic membership driven through the committed sequence, in every
+    case with the identical churn schedule in the clean twin.  Stable-
+    index membership: all ten authorities are registered at genesis; an
+    absent joiner starts at stake 0 and a committed ADD activates it."""
+    n = 10
+    return [
+        Scenario(
+            name="reconfig-continuous-churn",
+            description=(
+                "three epoch transitions under attack: a stake reweight, "
+                "an ADD that a genesis-absent authority joins through the "
+                "snapshot stream (its manifest carries the epoch chain), "
+                "and a REMOVE that cleanly retires a live node — all "
+                "while an equivocator attacks"
+            ),
+            nodes=n,
+            duration_s=24.0,
+            seed=18,
+            leader_timeout_s=0.3,
+            adversaries=(AdversarySpec(node=7, behavior="equivocate"),),
+            snapshot_catchup=True,
+            catchup_threshold_commits=25,
+            reconfig=True,
+            stakes=(1, 1, 1, 1, 1, 1, 1, 1, 1, 0),
+            absent=(9,),
+            churn=(
+                ChurnEvent(
+                    at_s=3.0, kind=CHANGE_REWEIGHT, authority=2, stake=3
+                ),
+                ChurnEvent(
+                    at_s=7.0,
+                    kind=CHANGE_ADD,
+                    authority=9,
+                    stake=1,
+                    follow_delay_s=3.0,
+                ),
+                ChurnEvent(at_s=13.0, kind=CHANGE_REMOVE, authority=8),
+            ),
+            min_epoch=3,
+            min_ratio=0.5,
+        ),
+        Scenario(
+            name="reconfig-departing-leader",
+            description=(
+                "a frequently-elected leader is REMOVEd mid-run and "
+                "departs cleanly after the boundary retires its slots, "
+                "while a withholder attacks — commit cadence must carry "
+                "across the committee switch without a liveness stall"
+            ),
+            nodes=n,
+            duration_s=14.0,
+            seed=77,
+            leader_timeout_s=0.3,
+            adversaries=(AdversarySpec(node=6, behavior="withhold"),),
+            reconfig=True,
+            churn=(
+                ChurnEvent(
+                    at_s=5.0,
+                    kind=CHANGE_REMOVE,
+                    authority=1,
+                    follow_delay_s=2.5,
+                ),
+            ),
+            min_epoch=1,
+            min_ratio=0.5,
+        ),
+        Scenario(
+            name="reconfig-cross-boundary-rejoin",
+            description=(
+                "a genesis-absent authority sleeps through TWO boundaries "
+                "(a reweight, then a REMOVE) before its own ADD lands; it "
+                "then boots from an empty WAL and must land on the "
+                "epoch-3 committee via the snapshot epoch chain, under an "
+                "invalid-signing adversary"
+            ),
+            nodes=n,
+            duration_s=26.0,
+            seed=5,
+            leader_timeout_s=0.3,
+            adversaries=(AdversarySpec(node=6, behavior="invalid_sig"),),
+            snapshot_catchup=True,
+            catchup_threshold_commits=25,
+            reconfig=True,
+            stakes=(1, 1, 1, 1, 1, 1, 1, 1, 1, 0),
+            absent=(9,),
+            churn=(
+                ChurnEvent(
+                    at_s=3.0, kind=CHANGE_REWEIGHT, authority=3, stake=2
+                ),
+                ChurnEvent(at_s=6.0, kind=CHANGE_REMOVE, authority=8),
+                ChurnEvent(
+                    at_s=11.0,
+                    kind=CHANGE_ADD,
+                    authority=9,
+                    stake=1,
+                    follow_delay_s=3.0,
+                ),
+            ),
+            min_epoch=3,
+            min_ratio=0.5,
+        ),
+    ]
+
+
 def scenario_by_name(name: str) -> Scenario:
-    for scenario in default_matrix():
+    matrix = default_matrix() + reconfig_matrix()
+    for scenario in matrix:
         if scenario.name == name:
             return scenario
     raise KeyError(
         f"unknown scenario {name!r} "
-        f"(known: {', '.join(s.name for s in default_matrix())})"
+        f"(known: {', '.join(s.name for s in matrix)})"
     )
+
+
+def run_reconfig_matrix(
+    scenarios: Optional[List[Scenario]] = None,
+    wal_root: Optional[str] = None,
+    real_crypto: bool = False,
+) -> dict:
+    """Run the continuous-churn family and aggregate the RECONFIG artifact
+    document (tools/reconfig_matrix.py pins it into RECONFIG_rNN.json)."""
+    doc = run_matrix(
+        scenarios if scenarios is not None else reconfig_matrix(),
+        wal_root=wal_root,
+        real_crypto=real_crypto,
+    )
+    doc["kind"] = "mysticeti-reconfig-matrix"
+    doc["metric"] = "reconfig"
+    return doc
 
 
 def run_matrix(

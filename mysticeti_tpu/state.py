@@ -85,6 +85,12 @@ class CoreRecoveredState:
     replay_start: WalPosition = 0
     replayed_bytes: int = 0
     checkpoint_height: int = 0
+    # Reconfiguration (reconfig.py): the serialized epoch chain from the
+    # recovering checkpoint/snapshot, plus the commits replayed AFTER that
+    # baseline — Core re-scans them so a crash between a boundary commit and
+    # the next checkpoint still reboots into the right epoch.
+    epoch_chain: bytes = b""
+    recovered_commits: List[CommitData] = field(default_factory=list)
 
 
 @dataclass
@@ -122,6 +128,7 @@ class RecoveredStateBuilder:
         self._checkpoint_height = 0
         self._replay_start: WalPosition = 0
         self._replayed_bytes = 0
+        self._epoch_chain = b""
 
     def seed_checkpoint(self, checkpoint) -> None:
         """Boot the fold from a durable checkpoint instead of genesis: the
@@ -139,6 +146,7 @@ class RecoveredStateBuilder:
         self._base_committed = list(checkpoint.committed_refs)
         self._checkpoint_height = checkpoint.commit_height
         self._replay_start = checkpoint.wal_position
+        self._epoch_chain = checkpoint.epoch_chain
 
     def snapshot(self, manifest) -> None:
         """Fold a persisted snapshot-adoption entry (WAL_ENTRY_SNAPSHOT): the
@@ -152,6 +160,8 @@ class RecoveredStateBuilder:
         self._base_height = manifest.commit_height
         self._base_committed = list(manifest.committed_refs)
         self._committed_sub_dags = []
+        if manifest.epoch_chain:
+            self._epoch_chain = manifest.epoch_chain
 
     def note_replayed(self, replayed_bytes: int) -> None:
         self._replayed_bytes = replayed_bytes
@@ -215,6 +225,8 @@ class RecoveredStateBuilder:
             replay_start=self._replay_start,
             replayed_bytes=self._replayed_bytes,
             checkpoint_height=self._checkpoint_height,
+            epoch_chain=self._epoch_chain,
+            recovered_commits=list(self._committed_sub_dags),
         )
         observer = CommitObserverRecoveredState(
             sub_dags=self._committed_sub_dags,

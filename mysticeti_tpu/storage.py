@@ -669,6 +669,11 @@ class Checkpoint:
     committed_refs: List[BlockReference]
     index: List[Tuple[BlockReference, WalPosition, bool]]
     path: str = ""
+    # Reconfiguration (reconfig.py): the serialized epoch chain as of this
+    # checkpoint.  Soft serialization tail — absent on pre-reconfig files
+    # (they decode as "still epoch 0") and omitted when empty, so frozen-
+    # committee deployments keep byte-identical checkpoints.
+    epoch_chain: bytes = b""
 
     def to_bytes(self) -> bytes:
         from .state import Include, encode_payload
@@ -703,6 +708,8 @@ class Checkpoint:
             w.u64(position)
             w.u8(1 if proposed else 0)
             ref.encode(w)
+        if self.epoch_chain:
+            w.bytes(self.epoch_chain)
         body = w.finish()
         return zlib.crc32(body).to_bytes(4, "little") + body
 
@@ -748,6 +755,7 @@ class Checkpoint:
             position = r.u64()
             proposed = bool(r.u8())
             index.append((BlockReference.decode(r), position, proposed))
+        epoch_chain = r.bytes() if not r.done() else b""
         r.expect_done()
         return Checkpoint(
             wal_position=wal_position,
@@ -761,6 +769,7 @@ class Checkpoint:
             pending=pending,
             committed_refs=committed_refs,
             index=index,
+            epoch_chain=epoch_chain,
         )
 
 
@@ -838,6 +847,12 @@ class SnapshotManifest:
     gc_round: int
     chain_digest: bytes
     committed_refs: List[BlockReference] = field(default_factory=list)
+    # Reconfiguration: the serving node's epoch chain — a rejoiner absent
+    # across one or more boundaries re-derives the CURRENT committee from
+    # this before processing the post-baseline block stream.  Soft tail
+    # (omitted when empty), so pre-reconfig manifests stay byte-identical
+    # and decode fine both ways.
+    epoch_chain: bytes = b""
 
     def to_bytes(self) -> bytes:
         w = Writer()
@@ -848,6 +863,8 @@ class SnapshotManifest:
         w.u32(len(self.committed_refs))
         for ref in self.committed_refs:
             ref.encode(w)
+        if self.epoch_chain:
+            w.bytes(self.epoch_chain)
         return w.finish()
 
     @staticmethod
@@ -863,6 +880,7 @@ class SnapshotManifest:
         leader = _read_opt_ref(r)
         chain_digest = r.fixed(32)
         refs = [BlockReference.decode(r) for _ in range(r.u32())]
+        epoch_chain = r.bytes() if not r.done() else b""
         r.expect_done()
         return SnapshotManifest(
             commit_height=commit_height,
@@ -870,6 +888,7 @@ class SnapshotManifest:
             gc_round=gc_round,
             chain_digest=chain_digest,
             committed_refs=refs,
+            epoch_chain=epoch_chain,
         )
 
 
@@ -1025,6 +1044,11 @@ class StorageLifecycle:
             pending=list(core.pending),
             committed_refs=sorted(self._committed, key=_ref_sort_key),
             index=core.block_store.index_entries_snapshot(self.retired_round),
+            epoch_chain=(
+                core.reconfig.chain.to_bytes()
+                if getattr(core, "reconfig", None) is not None
+                else b""
+            ),
         )
         name = f"{CHECKPOINT_PREFIX}{self.commit_height:012d}"
         path = os.path.join(self.directory, name)

@@ -119,22 +119,32 @@ class Syncer:
 
             tracer = spans.active()
             t_commit = tracer.now() if tracer is not None else 0.0
-            newly_committed = self.core.try_commit()
-            if newly_committed:
-                log.debug(
-                    "committed %d leaders up to round %d",
-                    len(newly_committed),
-                    max(b.round() for b in newly_committed),
-                )
-            committed_subdags = self.commit_observer.handle_commit(newly_committed)
-            self.core.handle_committed_subdag(
-                committed_subdags, self.commit_observer.aggregator_state()
-            )
-            if tracer is not None:
-                # One span per decided leader: decision + observer +
-                # commit/state persistence.
-                for block in newly_committed:
-                    tracer.record_span(
-                        "commit", block.reference, t_commit,
-                        authority=self.core.authority,
+            while True:
+                newly_committed = self.core.try_commit()
+                if newly_committed:
+                    log.debug(
+                        "committed %d leaders up to round %d",
+                        len(newly_committed),
+                        max(b.round() for b in newly_committed),
                     )
+                committed_subdags = self.commit_observer.handle_commit(
+                    newly_committed
+                )
+                self.core.handle_committed_subdag(
+                    committed_subdags, self.commit_observer.aggregator_state()
+                )
+                if tracer is not None:
+                    # One span per decided leader: decision + observer +
+                    # commit/state persistence.
+                    for block in newly_committed:
+                        tracer.record_span(
+                            "commit", block.reference, t_commit,
+                            authority=self.core.authority,
+                        )
+                # Reconfiguration makes try_commit slot-sequential (one
+                # decided leader per pass, so an epoch switch lands between
+                # slots); drain the remaining decidable slots here.  With
+                # the knob off a pass decides everything at once and this
+                # loop runs exactly once — the seed behavior.
+                if self.core.reconfig is None or not newly_committed:
+                    break

@@ -665,7 +665,7 @@ class StatementBlock:
                 raise VerificationError(
                     f"digest mismatch for {self.reference!r}"
                 )
-        if self.epoch != committee.epoch:
+        if not committee.accepts_epoch(self.epoch):
             raise VerificationError(
                 f"block epoch {self.epoch} != committee epoch {committee.epoch}"
             )

@@ -44,9 +44,19 @@ class TestThresholds:
         with pytest.raises(ValueError):
             Committee.new_test([])
 
-    def test_zero_stake_rejected(self):
+    def test_zero_stake_is_registered_but_inactive(self):
+        # Stable-index membership (reconfig.py): stake 0 marks a registered
+        # authority that is currently INACTIVE — it keeps its index and key
+        # but contributes nothing to thresholds and is unelectable.
+        c = Committee.new_test([1, 0, 1])
+        assert c.total_stake == 2
+        assert c.known_authority(1)
+        assert not c.is_active(1)
+        # Negative stakes and an all-inactive committee stay rejected.
         with pytest.raises(ValueError):
-            Committee.new_test([1, 0, 1])
+            Committee.new_test([1, -1, 1])
+        with pytest.raises(ValueError):
+            Committee.new_test([0, 0, 0])
 
 
 class TestLeaderElection:

@@ -44,7 +44,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 ARTIFACT_GLOBS = (
     "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
     "SCENARIO_*.json", "PERF_ATTR_*.json", "DETSAN_*.json",
-    "FINALITY_*.json",
+    "FINALITY_*.json", "RECONFIG_*.json",
 )
 
 # >10% below the best prior round fails the gate.
@@ -193,6 +193,45 @@ def normalize(path: str) -> List[dict]:
             return out
         return [_record(round_, source, "unparsed", None, "",
                         note="scenario artifact with no verdicts")]
+
+    # RECONFIG: the continuous-churn epoch-reconfiguration matrix
+    # (tools/reconfig_matrix.py).  Verdict rows score pass (1.0) / fail
+    # (0.0) like the scenario matrix — the generic gate fires exactly when
+    # a churn scenario FLIPS from pass to fail; epochs reached and the
+    # throughput ratio ride along as context.  The live-testbed epoch
+    # cycle (one add-node + one remove-node epoch under load) and the
+    # same-seed byte-identity check score the same way.
+    if doc.get("metric") == "reconfig":
+        for verdict in doc.get("scenarios") or []:
+            scenario = (verdict.get("scenario") or {}).get("name")
+            if not scenario:
+                continue
+            out.append(_record(
+                round_, source, f"{family}.{scenario}.passed",
+                1.0 if verdict.get("passed") else 0.0, "pass",
+                ratio=verdict.get("throughput_ratio"),
+                max_epoch=verdict.get("max_epoch"),
+                min_epoch=verdict.get("min_epoch"),
+                safety_ok=verdict.get("safety_ok"),
+            ))
+        determinism = doc.get("determinism") or {}
+        if determinism.get("byte_identical") is not None:
+            out.append(_record(
+                round_, source, f"{family}.determinism_byte_identical",
+                1.0 if determinism["byte_identical"] else 0.0, "pass",
+                scenario=determinism.get("scenario"),
+            ))
+        live = doc.get("live") or {}
+        if live.get("passed") is not None:
+            out.append(_record(
+                round_, source, f"{family}.live_epoch_cycle",
+                1.0 if live["passed"] else 0.0, "pass",
+                nodes=live.get("nodes"), epochs=live.get("epochs_reached"),
+            ))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="reconfig artifact with no verdicts")]
 
     # DETSAN: the determinism-sanitizer verdict (tools/detsan.py).  Every
     # scored value is pass (1.0) / fail (0.0), so the generic gate fires

@@ -149,14 +149,16 @@ def render_dashboard(snapshot: dict, targets, tick: int) -> str:
             + "  ".join(f"{k}={v}" for k, v in sorted(weather.items()))
         )
     lines.append(
-        f"{'node':<6}{'state':<12}{'commit/s':>10}{'straggler':>12}"
-        f"{'lag p99':>10}{'fin p99':>10}  {'top cpu subsystems':<32}"
+        f"{'node':<6}{'state':<12}{'epoch':>7}{'commit/s':>10}"
+        f"{'straggler':>12}{'lag p99':>10}{'fin p99':>10}  "
+        f"{'top cpu subsystems':<32}"
     )
     stragglers = snapshot.get("straggler_score", {})
     rates = snapshot.get("commit_rate_by_node", {})
     lags = snapshot.get("loop_lag_p99_by_node", {})
     finality = snapshot.get("finality_p99_by_node", {})
     top_subs = snapshot.get("top_cpu_subsystems", {})
+    epochs = snapshot.get("epochs_by_node", {})
     for i in range(len(targets)):
         node = str(i)
         if node in snapshot["unreachable"]:
@@ -170,11 +172,28 @@ def render_dashboard(snapshot: dict, targets, tick: int) -> str:
         lag_ms = lags.get(node, 0.0) * 1e3
         fin_ms = finality.get(node, 0.0) * 1e3
         lines.append(
-            f"{node:<6}{state:<12}{rates.get(node, 0.0):>10.3f}"
+            f"{node:<6}{state:<12}{epochs.get(node, 0):>7}"
+            f"{rates.get(node, 0.0):>10.3f}"
             f"{stragglers.get(node, 0):>12}"
             f"{lag_ms:>8.1f}ms"
             f"{fin_ms:>8.0f}ms  "
             f"{','.join(top_subs.get(node, []) or ['-']):<32}"
+        )
+    # Mixed-epoch readiness warning: nodes disagreeing on the consensus
+    # epoch is EXPECTED for the seconds around a reconfiguration boundary
+    # but a lagging straggler beyond that — surface it without tripping
+    # the red machinery (commit-skew and participation gates own "red").
+    distinct_epochs = {e for e in epochs.values()}
+    if len(distinct_epochs) > 1:
+        by_epoch: Dict[int, List[str]] = {}
+        for node, e in sorted(epochs.items()):
+            by_epoch.setdefault(int(e), []).append(node)
+        lines.append(
+            "WARNING mixed epochs: "
+            + "  ".join(
+                f"epoch {e}: nodes {','.join(nodes)}"
+                for e, nodes in sorted(by_epoch.items())
+            )
         )
     alerts = snapshot.get("slo_alert_totals", {})
     if alerts:
