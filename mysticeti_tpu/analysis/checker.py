@@ -43,6 +43,9 @@ RULE_LOCK_ORDER = "lock-order"
 RULE_GUARD_INFERENCE = "guard-inference"
 # Suppression hygiene: an ignore comment must still suppress something.
 RULE_UNUSED_SUPPRESSION = "unused-suppression"
+# Native extension fallback contract (native/__init__.py): every call into
+# the C extension must sit under a `native is None`-aware gate.
+RULE_NATIVE_FALLBACK = "native-fallback"
 
 RULES = (
     RULE_ASYNC_BLOCKING,
@@ -58,6 +61,7 @@ RULES = (
     RULE_LOCK_ORDER,
     RULE_GUARD_INFERENCE,
     RULE_UNUSED_SUPPRESSION,
+    RULE_NATIVE_FALLBACK,
 )
 
 # -- rule configuration -------------------------------------------------------
@@ -977,6 +981,154 @@ class FileAnalysis:
     module_ignores: Set[str]
 
 
+def _collect_native_aliases(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to the native extension object via
+    ``from .native import native [as X]`` (or the absolute form)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        mod = node.module or ""
+        if mod != "native" and not mod.endswith(".native"):
+            continue
+        for a in node.names:
+            if a.name == "native":
+                aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _native_gate_polarity(test: ast.expr, alias: str) -> Optional[str]:
+    """Which branch of an ``if`` with this test is native-gated for ``alias``.
+
+    Returns ``"body"`` (``alias is not None`` / ``hasattr(alias, ...)``),
+    ``"orelse"`` (``alias is None`` — the early-return shape), or ``None``.
+    The comparison may sit inside a ``boolop`` conjunction
+    (``if native is not None and end > 0:`` — wal.py's idiom); the scan is
+    deliberately syntactic, mirroring how the contract is written at every
+    existing call site.
+    """
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare) and len(sub.ops) == 1:
+            left, op, right = sub.left, sub.ops[0], sub.comparators[0]
+            sides = (left, right)
+            has_alias = any(
+                isinstance(s, ast.Name) and s.id == alias for s in sides
+            )
+            has_none = any(
+                isinstance(s, ast.Constant) and s.value is None for s in sides
+            )
+            if has_alias and has_none:
+                if isinstance(op, (ast.IsNot, ast.NotEq)):
+                    return "body"
+                if isinstance(op, (ast.Is, ast.Eq)):
+                    return "orelse"
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "hasattr"
+            and sub.args
+            and isinstance(sub.args[0], ast.Name)
+            and sub.args[0].id == alias
+        ):
+            return "body"
+    return None
+
+
+# Statement fields holding nested statement lists — skipped by the
+# expression scan, recursed into by the block walk.
+_STMT_BLOCK_FIELDS = frozenset({"body", "orelse", "finalbody", "handlers"})
+
+
+def check_native_fallback(tree: ast.Module, path: str) -> List[Finding]:
+    """The ``native-fallback`` rule: every ``native.<fn>`` attribute access
+    on a module alias of the C extension must sit under a
+    ``native is None``-aware branch (or module-level gate) so the
+    pure-Python fallback path exists — the contract ``native/__init__.py``
+    documents (the extension is an acceleration, never a hard dependency;
+    ``MYSTICETI_NO_NATIVE=1`` must always work).
+
+    Scope: direct accesses through a module alias (``from .native import
+    native as X`` → ``X.fn``).  Indirection through instance attributes
+    (committee.py stores the module on ``self``) is the storing class's
+    contract — the assignment itself is still checked here.
+    Recognized gates: an enclosing ``if X is not None:`` /
+    ``hasattr(X, ...)`` branch, the ``else`` of ``if X is None:``, or the
+    statements following an ``if X is None: return/raise/continue`` early
+    exit.
+    """
+    aliases = _collect_native_aliases(tree)
+    if not aliases:
+        return []
+    findings: List[Finding] = []
+
+    def scan_exprs(node: ast.AST, guarded: Set[str]) -> None:
+        for field, value in ast.iter_fields(node):
+            if field in _STMT_BLOCK_FIELDS:
+                continue
+            items = value if isinstance(value, list) else [value]
+            for item in items:
+                if not isinstance(item, ast.AST):
+                    continue
+                for sub in ast.walk(item):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id in aliases
+                        and sub.value.id not in guarded
+                    ):
+                        findings.append(
+                            Finding(
+                                RULE_NATIVE_FALLBACK,
+                                path,
+                                sub.lineno,
+                                sub.col_offset,
+                                f"native access '{sub.value.id}.{sub.attr}' "
+                                f"outside a '{sub.value.id} is None'-aware "
+                                "gate — every native call site needs a "
+                                "pure-Python fallback branch "
+                                "(native/__init__.py contract; gate with "
+                                f"'if {sub.value.id} is not None:' or "
+                                "hasattr)",
+                            )
+                        )
+
+    def walk_block(stmts: Sequence[ast.stmt], guarded: Set[str]) -> None:
+        flowing = set(guarded)
+        for st in stmts:
+            if isinstance(st, ast.If):
+                scan_exprs(st.test, flowing)
+                gates = {
+                    a: _native_gate_polarity(st.test, a) for a in aliases
+                }
+                walk_block(
+                    st.body,
+                    flowing | {a for a, p in gates.items() if p == "body"},
+                )
+                walk_block(
+                    st.orelse,
+                    flowing | {a for a, p in gates.items() if p == "orelse"},
+                )
+                if st.body and isinstance(
+                    st.body[-1], (ast.Return, ast.Raise, ast.Continue,
+                                  ast.Break)
+                ):
+                    # `if X is None: return fallback` — everything after
+                    # the early exit runs native-gated.
+                    flowing |= {a for a, p in gates.items() if p == "orelse"}
+                continue
+            scan_exprs(st, flowing)
+            for field in ("body", "orelse", "finalbody"):
+                sub_block = getattr(st, field, None)
+                if sub_block:
+                    walk_block(sub_block, flowing)
+            for handler in getattr(st, "handlers", ()) or ():
+                walk_block(handler.body, flowing)
+        return
+
+    walk_block(tree.body, set())
+    return findings
+
+
 def _analyze_module(
     source: str,
     path: str,
@@ -1009,6 +1161,8 @@ def _analyze_module(
             findings.append(
                 Finding(RULE_AWAIT_ATOMICITY, path, rf.line, rf.col, rf.message)
             )
+    if RULE_NATIVE_FALLBACK not in ignores:
+        findings.extend(check_native_fallback(tree, path))
     locks = lockgraph.collect_module_locks(tree, aliases, path, source)
     if RULE_GUARD_INFERENCE not in ignores:
         for gf in lockgraph.check_guard_inference(locks, GUARDED_FIELDS):

@@ -467,7 +467,15 @@ class HealthProbe:
             # blocking-call census, GIL convoy ratio.  All-zero under the
             # sim (the probe and sampler never start in virtual time), so
             # the deterministic timeline stays byte-identical.
-            snapshot["host"] = self._host_monitor.state()
+            host = dict(self._host_monitor.state())
+            # Which native data-plane functions resolved in this process
+            # (native/__init__.py): lets an operator — and the A/B
+            # harness — tell from /health alone whether a node is running
+            # the C extension or the pure-Python fallback.
+            from .native import active_functions
+
+            host["native_active"] = list(active_functions())
+            snapshot["host"] = host
         alerts = self._watchdog(snapshot, lags)
         snapshot["status"] = "degraded" if self._firing else "ok"
         self._export_gauges(snapshot, lags)

@@ -369,6 +369,36 @@ class Metrics:
             buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
                      0.5, 1.0, 5.0],
         )
+        # Native data plane (native/mysticeti_native.cpp): which native
+        # functions resolved in THIS process — an info series (value
+        # constant 1) so A/B artifacts and fleetmon can tell which path a
+        # run actually measured.  The "any" row is always present: 1 with
+        # the extension, 0 on the pure-Python fallback (no toolchain,
+        # build failure, MYSTICETI_NO_NATIVE=1).
+        self.mysticeti_native_active = gauge(
+            "mysticeti_native_active",
+            "info series: native data-plane functions resolved (fn=any "
+            "summarizes extension presence)",
+            labels=("fn",),
+        )
+        from .native import active_functions as _native_active_functions
+
+        _active_fns = _native_active_functions()
+        for _fn in _active_fns:
+            self.mysticeti_native_active.labels(_fn).set(1)
+        self.mysticeti_native_active.labels("any").set(1 if _active_fns else 0)
+        # Batched decode+digest batches routed off the event loop
+        # (core_task.DataPlaneOffload) — stage wall time measured IN the
+        # offload worker, the verify_pipeline_stage_seconds sibling for the
+        # host data plane.
+        self.dataplane_offload_seconds = histogram(
+            "dataplane_offload_seconds",
+            "per-batch time in each data-plane offload stage, measured in "
+            "the offload worker thread (queue wait excluded)",
+            labels=("stage",),
+            buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 5.0],
+        )
         # Zero-tax data plane (the no-chip flavor parity work): which
         # batches never touched the socket, what the wire actually carried,
         # and the window the adaptive collector chose.

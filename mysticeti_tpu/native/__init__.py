@@ -3,14 +3,22 @@
 ``native`` resolves to the compiled ``_native`` module, or ``None`` when no
 toolchain is available — callers must keep a Python fallback path (the
 extension is an acceleration, matching the reference's Rust storage hot paths,
-never a hard dependency).
+never a hard dependency).  The ``native-fallback`` lint rule
+(mysticeti_tpu/analysis) enforces that every call site sits under a
+``native is None``-aware gate.
 
 The extension is built on first import with ``g++ -O2 -shared -fPIC ... -lz``
 into this directory; set ``MYSTICETI_NO_NATIVE=1`` to disable both the build
 and the import (useful to pin tests to the fallback path).
+
+A failed build is remembered: a marker file keyed by the source sha256 is
+written next to ``_native.so`` so a fleet of processes doesn't re-run the
+doomed ``g++`` invocation (and re-log the warning) on every boot.  Editing
+the source invalidates the marker.
 """
 from __future__ import annotations
 
+import hashlib
 import importlib
 import logging
 import os
@@ -24,11 +32,42 @@ log = logging.getLogger(__name__)
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "mysticeti_native.cpp")
 _SO = os.path.join(_DIR, "_native.so")
+_FAIL_MARKER = os.path.join(_DIR, "_native.buildfail")
 
 
-def _build() -> bool:
+def _src_fingerprint() -> str:
+    with open(_SRC, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def _read_marker() -> str:
+    try:
+        with open(_FAIL_MARKER, "r", encoding="ascii") as fh:
+            return fh.read().strip()
+    except OSError:
+        return ""
+
+
+def _write_marker(fingerprint: str) -> None:
+    try:
+        with open(_FAIL_MARKER, "w", encoding="ascii") as fh:
+            fh.write(fingerprint)
+    except OSError:  # read-only dir: the retry cost returns, nothing breaks
+        pass
+
+
+def _clear_marker() -> None:
+    try:
+        os.unlink(_FAIL_MARKER)
+    except OSError:
+        pass
+
+
+def _build(fingerprint: str = "") -> bool:
     gxx = shutil.which("g++") or shutil.which("c++")
     if gxx is None:
+        if fingerprint:
+            _write_marker(fingerprint)
         return False
     include = sysconfig.get_path("include")
     # Build to a temp file then atomically rename: concurrent processes
@@ -47,8 +86,11 @@ def _build() -> bool:
         if proc.returncode != 0:
             log.warning("native build failed: %s", proc.stderr.decode()[-500:])
             os.unlink(tmp)
+            if fingerprint:
+                _write_marker(fingerprint)
             return False
         os.replace(tmp, _SO)
+        _clear_marker()
         return True
     except Exception as exc:  # toolchain quirks must never break the node
         log.warning("native build error: %r", exc)
@@ -56,6 +98,8 @@ def _build() -> bool:
             os.unlink(tmp)
         except OSError:
             pass
+        if fingerprint:
+            _write_marker(fingerprint)
         return False
 
 
@@ -74,10 +118,18 @@ def _load():
         # Source-less deploy: a prebuilt .so may still match this interpreter.
         return _import() if os.path.exists(_SO) else None
     stale = not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-    if stale and not _build():
-        return None
+    if stale:
+        fingerprint = _src_fingerprint()
+        if _read_marker() == fingerprint:
+            # This exact source already failed to build on this box; the
+            # warning was logged when the marker was written.
+            log.debug("native build previously failed for this source; "
+                      "skipping retry (remove %s to force)", _FAIL_MARKER)
+            return None
+        if not _build(fingerprint):
+            return None
     mod = _import()
-    if mod is None and not stale and _build():
+    if mod is None and not stale and _build(_src_fingerprint()):
         # A fresh-looking .so can still target another ABI/arch (e.g. the
         # checkout moved between interpreters); one rebuild fixes that.
         mod = _import()
@@ -85,3 +137,19 @@ def _load():
 
 
 native = _load()
+
+
+def active_functions() -> tuple:
+    """Sorted names of the native functions resolved in this process.
+
+    Empty when the extension is absent (no toolchain, build failure, or
+    ``MYSTICETI_NO_NATIVE=1``) — the source of truth for the
+    ``mysticeti_native_active`` info series and the ``/health`` host block,
+    so A/B artifacts can record which path a run actually measured.
+    """
+    if native is None:
+        return ()
+    return tuple(sorted(
+        name for name in dir(native)
+        if not name.startswith("_") and callable(getattr(native, name))
+    ))

@@ -1090,6 +1090,384 @@ PyObject* decode_block(PyObject*, PyObject* args) {
   return result;
 }
 
+// ---------------------------------------------------------------------------
+// BLAKE2b-256 (RFC 7693) — embedded so the batched digest path links against
+// nothing beyond zlib (the build contract of native/__init__.py).  Unkeyed,
+// no salt/personal, 32-byte output: exactly
+// ``hashlib.blake2b(data, digest_size=32)``, pinned byte-for-byte by the
+// parity corpus test against crypto.blake2b_256.
+// ---------------------------------------------------------------------------
+
+namespace blake2b {
+
+constexpr uint64_t kIV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+constexpr uint8_t kSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t rotr64(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+
+struct Ctx {
+  uint64_t h[8];
+  uint64_t t0, t1;
+  uint8_t buf[128];
+  size_t buflen;
+};
+
+inline void compress(Ctx& c, const uint8_t* block, bool last) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; i++) std::memcpy(&m[i], block + 8 * i, 8);
+  for (int i = 0; i < 8; i++) v[i] = c.h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = kIV[i];
+  v[12] ^= c.t0;
+  v[13] ^= c.t1;
+  if (last) v[14] = ~v[14];
+#define B2B_G(a, b, cc, d, x, y)              \
+  v[a] = v[a] + v[b] + (x);                   \
+  v[d] = rotr64(v[d] ^ v[a], 32);             \
+  v[cc] = v[cc] + v[d];                       \
+  v[b] = rotr64(v[b] ^ v[cc], 24);            \
+  v[a] = v[a] + v[b] + (y);                   \
+  v[d] = rotr64(v[d] ^ v[a], 16);             \
+  v[cc] = v[cc] + v[d];                       \
+  v[b] = rotr64(v[b] ^ v[cc], 63);
+// Rounds unrolled with literal indices: kSigma is constexpr, so every
+// m[kSigma[r][i]] folds to a direct load — the loop-carried indirect
+// indexing was the compress bottleneck under -O2/-O3.
+#define B2B_ROUND(r)                                      \
+  B2B_G(0, 4, 8, 12, m[kSigma[r][0]], m[kSigma[r][1]]);   \
+  B2B_G(1, 5, 9, 13, m[kSigma[r][2]], m[kSigma[r][3]]);   \
+  B2B_G(2, 6, 10, 14, m[kSigma[r][4]], m[kSigma[r][5]]);  \
+  B2B_G(3, 7, 11, 15, m[kSigma[r][6]], m[kSigma[r][7]]);  \
+  B2B_G(0, 5, 10, 15, m[kSigma[r][8]], m[kSigma[r][9]]);  \
+  B2B_G(1, 6, 11, 12, m[kSigma[r][10]], m[kSigma[r][11]]); \
+  B2B_G(2, 7, 8, 13, m[kSigma[r][12]], m[kSigma[r][13]]);  \
+  B2B_G(3, 4, 9, 14, m[kSigma[r][14]], m[kSigma[r][15]]);
+  B2B_ROUND(0); B2B_ROUND(1); B2B_ROUND(2); B2B_ROUND(3);
+  B2B_ROUND(4); B2B_ROUND(5); B2B_ROUND(6); B2B_ROUND(7);
+  B2B_ROUND(8); B2B_ROUND(9); B2B_ROUND(10); B2B_ROUND(11);
+#undef B2B_ROUND
+#undef B2B_G
+  for (int i = 0; i < 8; i++) c.h[i] ^= v[i] ^ v[i + 8];
+}
+
+inline void init256(Ctx& c) {
+  for (int i = 0; i < 8; i++) c.h[i] = kIV[i];
+  c.h[0] ^= 0x01010000ULL ^ 32ULL;  // digest_size=32, no key, fanout/depth 1
+  c.t0 = c.t1 = 0;
+  c.buflen = 0;
+}
+
+inline void update(Ctx& c, const uint8_t* in, size_t len) {
+  while (len > 0) {
+    if (c.buflen == 128) {
+      // The buffer only compresses once MORE input is known to follow —
+      // the final block must flow through the last-block flag instead.
+      c.t0 += 128;
+      if (c.t0 < 128) c.t1++;
+      compress(c, c.buf, false);
+      c.buflen = 0;
+    }
+    size_t take = std::min(len, 128 - c.buflen);
+    std::memcpy(c.buf + c.buflen, in, take);
+    c.buflen += take;
+    in += take;
+    len -= take;
+  }
+}
+
+inline void final256(Ctx& c, uint8_t out[32]) {
+  c.t0 += c.buflen;
+  if (c.t0 < c.buflen) c.t1++;
+  std::memset(c.buf + c.buflen, 0, 128 - c.buflen);
+  compress(c, c.buf, true);
+  for (int i = 0; i < 32; i++)
+    out[i] = static_cast<uint8_t>(c.h[i / 8] >> (8 * (i % 8)));
+}
+
+inline void hash256(const uint8_t* in, size_t len, uint8_t out[32]) {
+  Ctx c;
+  init256(c);
+  update(c, in, len);
+  final256(c, out);
+}
+
+// Both StatementBlock digests in ~one pass: the block digest covers the
+// full bytes, the signature pre-hash covers the bytes minus the 64-byte
+// trailer — the two streams are IDENTICAL up to the pre-hash message's
+// final partial block, so hash the shared prefix once and fork the state.
+// Cuts the hashing work per block from len + (len-64) to ~len + 128.
+inline void hash256_pair(const uint8_t* in, size_t len, uint8_t full_out[32],
+                         uint8_t signed_out[32]) {
+  const size_t sig = static_cast<size_t>(kSignatureSize);
+  if (len < sig) {
+    hash256(in, len, full_out);
+    hash256(in, 0, signed_out);  // Python's data[:-64] on short input: b""
+    return;
+  }
+  const size_t msg_len = len - sig;
+  // All full 128-byte blocks strictly before the pre-hash's final block;
+  // `update` keeps a full buffered block uncompressed until more input
+  // arrives, so the forked copies continue bit-identically to streaming.
+  const size_t prefix = msg_len == 0 ? 0 : ((msg_len - 1) / 128) * 128;
+  Ctx c;
+  init256(c);
+  update(c, in, prefix);
+  Ctx cs = c;
+  update(cs, in + prefix, msg_len - prefix);
+  final256(cs, signed_out);
+  update(c, in + prefix, len - prefix);
+  final256(c, full_out);
+}
+
+}  // namespace blake2b
+
+// block_digests(parts) -> [(digest32, signed_digest32)...]
+//
+// Batched StatementBlock digest path (types.py): for each serialized block,
+// the canonical blake2b-256 over the full bytes (the reference digest) AND
+// over the bytes minus the 64-byte signature trailer (the message Ed25519
+// signs — crypto.rs:77-84 layering).  One GIL round-trip hashes the whole
+// frame batch; the hashing itself runs with the GIL released, so the event
+// loop keeps scheduling while the offload thread grinds.  Sub-64-byte parts
+// hash an EMPTY trimmed message, matching Python's ``data[:-64]`` slice
+// semantics (such parts fail decode anyway; the slice parity keeps this
+// function order-independent from the decode step).
+PyObject* block_digests(PyObject*, PyObject* args) {
+  PyObject* parts;
+  if (!PyArg_ParseTuple(args, "O", &parts)) return nullptr;
+  PyObject* seq = PySequence_Fast(parts, "parts must be a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::vector<Py_buffer> views(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* part = PySequence_Fast_GET_ITEM(seq, i);
+    if (PyObject_GetBuffer(part, &views[i], PyBUF_SIMPLE) < 0) {
+      for (Py_ssize_t j = 0; j < i; ++j) PyBuffer_Release(&views[j]);
+      Py_DECREF(seq);
+      return nullptr;
+    }
+  }
+  std::vector<uint8_t> digests(static_cast<size_t>(n) * 64);
+  Py_BEGIN_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const uint8_t* data = static_cast<const uint8_t*>(views[i].buf);
+    const size_t len = static_cast<size_t>(views[i].len);
+    uint8_t* out = digests.data() + static_cast<size_t>(i) * 64;
+    blake2b::hash256_pair(data, len, out, out + 32);
+  }
+  Py_END_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; ++i) PyBuffer_Release(&views[i]);
+  Py_DECREF(seq);
+  PyObject* out = PyList_New(n);
+  if (out == nullptr) return nullptr;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* d = reinterpret_cast<const char*>(digests.data() +
+                                                  static_cast<size_t>(i) * 64);
+    PyObject* pair = Py_BuildValue("(y#y#)", d, (Py_ssize_t)32, d + 32,
+                                   (Py_ssize_t)32);
+    if (pair == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, pair);
+  }
+  return out;
+}
+
+// encode_blocks_frame(tag, stamped, mono_ns, wall_ns, parts) -> bytes
+//
+// Whole-frame payload for the Blocks-shaped wire messages (tags 2/4/12):
+// tag u8 [+ u64 sender-monotonic + u64 sender-wall when stamped] + u32
+// count + per block u32 length + raw bytes — byte-identical to
+// network.encode_message's Writer path (golden corpus pins it).  One call
+// replaces the per-block Writer append loop the FrameCache paid per
+// encode-once build; the copy runs with the GIL released.
+PyObject* encode_blocks_frame(PyObject*, PyObject* args) {
+  unsigned int tag;
+  int stamped;
+  unsigned long long mono_ns, wall_ns;
+  PyObject* parts;
+  if (!PyArg_ParseTuple(args, "IpKKO", &tag, &stamped, &mono_ns, &wall_ns,
+                        &parts))
+    return nullptr;
+  PyObject* seq = PySequence_Fast(parts, "blocks must be a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::vector<Py_buffer> views(static_cast<size_t>(n));
+  Py_ssize_t total = 1 + (stamped ? 16 : 0) + 4;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* part = PySequence_Fast_GET_ITEM(seq, i);
+    if (PyObject_GetBuffer(part, &views[i], PyBUF_SIMPLE) < 0) {
+      for (Py_ssize_t j = 0; j < i; ++j) PyBuffer_Release(&views[j]);
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    total += 4 + views[i].len;
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, total);
+  if (out == nullptr) {
+    for (Py_ssize_t i = 0; i < n; ++i) PyBuffer_Release(&views[i]);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  uint8_t* dst = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+  Py_BEGIN_ALLOW_THREADS
+  uint8_t* p = dst;
+  *p++ = static_cast<uint8_t>(tag);
+  if (stamped) {
+    std::memcpy(p, &mono_ns, 8);
+    std::memcpy(p + 8, &wall_ns, 8);
+    p += 16;
+  }
+  uint32_t count = static_cast<uint32_t>(n);
+  std::memcpy(p, &count, 4);
+  p += 4;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    uint32_t len = static_cast<uint32_t>(views[i].len);
+    std::memcpy(p, &len, 4);
+    std::memcpy(p + 4, views[i].buf, views[i].len);
+    p += 4 + views[i].len;
+  }
+  Py_END_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; ++i) PyBuffer_Release(&views[i]);
+  Py_DECREF(seq);
+  return out;
+}
+
+// split_frames(buffer, start, have, max_frame)
+//   -> ([(payload_off, payload_len)...], new_start, oversized_len)
+//
+// The _FrameReceiver assembly-buffer walk (network.py:_parse): split
+// [start, have) into complete 4-byte-length-prefixed frames.  Returns the
+// payload spans (the caller wraps them as memoryviews — the last step that
+// must touch Python objects), the new parse cursor, and the offending
+// length when a prefix exceeds ``max_frame`` (0 = none; the caller severs
+// exactly as the pure path does).
+PyObject* split_frames(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  unsigned long long start_arg, have_arg, max_frame;
+  if (!PyArg_ParseTuple(args, "y*KKK", &buf, &start_arg, &have_arg,
+                        &max_frame))
+    return nullptr;
+  const uint8_t* data = static_cast<const uint8_t*>(buf.buf);
+  Py_ssize_t start = static_cast<Py_ssize_t>(start_arg);
+  Py_ssize_t have = static_cast<Py_ssize_t>(have_arg);
+  if (have > buf.len) have = buf.len;
+  std::vector<std::pair<Py_ssize_t, Py_ssize_t>> spans;
+  unsigned long long oversized = 0;
+  Py_BEGIN_ALLOW_THREADS
+  while (have - start >= 4) {
+    uint32_t length = read_u32(data + start);
+    if (static_cast<unsigned long long>(length) > max_frame) {
+      oversized = length;
+      break;
+    }
+    Py_ssize_t end = start + 4 + static_cast<Py_ssize_t>(length);
+    if (end > have) break;
+    spans.emplace_back(start + 4, static_cast<Py_ssize_t>(length));
+    start = end;
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&buf);
+  PyObject* out = PyList_New(static_cast<Py_ssize_t>(spans.size()));
+  if (out == nullptr) return nullptr;
+  for (size_t i = 0; i < spans.size(); ++i) {
+    PyObject* pair =
+        Py_BuildValue("(nn)", spans[i].first, spans[i].second);
+    if (pair == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), pair);
+  }
+  return Py_BuildValue("(NnK)", out, start, oversized);
+}
+
+// parse_blocks_spans(payload) -> (tag, mono_ns, wall_ns, [(off, len)...])
+//
+// Native sibling of decode_message's Blocks-shaped branches (tags 2/4/12):
+// validates the whole payload body and returns per-block (offset, length)
+// spans — the caller builds zero-copy sub-views, deferring Python object
+// creation to the last step.  Rejection cases and MESSAGES are
+// byte-identical to serde.Reader's ("truncated input: need N bytes at P,
+// have H", "trailing garbage: N bytes"), so torn-frame error shapes stay
+// indistinguishable across the native/fallback paths (parity corpus).
+PyObject* parse_blocks_spans(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  const uint8_t* d = static_cast<const uint8_t*>(buf.buf);
+  const Py_ssize_t n = buf.len;
+  Py_ssize_t pos = 0;
+  auto fail_need = [&](Py_ssize_t need) -> PyObject* {
+    PyErr_Format(PyExc_ValueError,
+                 "truncated input: need %zd bytes at %zd, have %zd", need,
+                 pos, n);
+    PyBuffer_Release(&buf);
+    return nullptr;
+  };
+  if (n < 1) return fail_need(1);
+  const uint8_t tag = d[0];
+  pos = 1;
+  unsigned long long mono = 0, wall = 0;
+  if (tag == 12) {  // _MSG_BLOCKS_TIMESTAMPED: two u64 sender stamps first
+    if (pos + 8 > n) return fail_need(8);
+    mono = read_u64(d + pos);
+    pos += 8;
+    if (pos + 8 > n) return fail_need(8);
+    wall = read_u64(d + pos);
+    pos += 8;
+  } else if (tag != 2 && tag != 4) {  // _MSG_BLOCKS / _MSG_RESPONSE
+    PyErr_Format(PyExc_ValueError, "not a blocks-shaped frame: tag %d", tag);
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  if (pos + 4 > n) return fail_need(4);
+  const uint32_t count = read_u32(d + pos);
+  pos += 4;
+  std::vector<std::pair<Py_ssize_t, Py_ssize_t>> spans;
+  for (uint32_t i = 0; i < count; ++i) {
+    if (pos + 4 > n) return fail_need(4);
+    const uint32_t len = read_u32(d + pos);
+    pos += 4;
+    if (pos + static_cast<Py_ssize_t>(len) > n)
+      return fail_need(static_cast<Py_ssize_t>(len));
+    spans.emplace_back(pos, static_cast<Py_ssize_t>(len));
+    pos += static_cast<Py_ssize_t>(len);
+  }
+  if (pos != n) {
+    PyErr_Format(PyExc_ValueError, "trailing garbage: %zd bytes", n - pos);
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  PyBuffer_Release(&buf);
+  PyObject* out = PyList_New(static_cast<Py_ssize_t>(spans.size()));
+  if (out == nullptr) return nullptr;
+  for (size_t i = 0; i < spans.size(); ++i) {
+    PyObject* pair = Py_BuildValue("(nn)", spans[i].first, spans[i].second);
+    if (pair == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), pair);
+  }
+  return Py_BuildValue("(BKKN)", tag, mono, wall, out);
+}
+
 PyMethodDef kMethods[] = {
     {"decode_register", decode_register, METH_VARARGS,
      "Register the Python statement/reference classes for decode_block."},
@@ -1113,12 +1491,21 @@ PyMethodDef kMethods[] = {
     {"va_state", va_state, METH_VARARGS,
      "Canonical state snapshot bytes (committee.py state() layout)."},
     {"va_load", va_load, METH_VARARGS, "Restore one pending range."},
+    {"block_digests", block_digests, METH_VARARGS,
+     "Batched blake2b-256 (digest, signed-prehash) pairs over N blocks."},
+    {"encode_blocks_frame", encode_blocks_frame, METH_VARARGS,
+     "Serialize a whole Blocks-shaped frame payload in one call."},
+    {"split_frames", split_frames, METH_VARARGS,
+     "Split a length-prefixed assembly buffer into payload spans."},
+    {"parse_blocks_spans", parse_blocks_spans, METH_VARARGS,
+     "Validate a Blocks-shaped payload; returns per-block (off, len) spans."},
     {nullptr, nullptr, 0, nullptr},
 };
 
 PyModuleDef kModule = {
     PyModuleDef_HEAD_INIT, "_native",
-    "Native runtime helpers (WAL framing/scan).", -1, kMethods,
+    "Native runtime helpers (WAL framing/scan, decode, data plane).", -1,
+    kMethods,
 };
 
 }  // namespace

@@ -26,7 +26,7 @@ from .block_validator import AcceptAllBlockVerifier, BlockVerifier
 from .commit_observer import CommitObserver
 from .config import Parameters, ROUNDS_IN_EPOCH_MAX
 from .core import Core
-from .core_task import CoreTaskDispatcher
+from .core_task import CoreTaskDispatcher, DataPlaneOffload
 from .network import (
     BlockNotFound,
     Blocks,
@@ -137,6 +137,10 @@ class NetworkSyncer:
         self.block_verifier = block_verifier or AcceptAllBlockVerifier()
         self.metrics = metrics
         self.dispatcher = CoreTaskDispatcher(self.syncer, metrics=metrics)
+        # Batched native decode+digest off the event loop (core_task.py):
+        # inert (inline path) under sims, without the extension, or for
+        # small frames — see DataPlaneOffload.should_offload.
+        self.dataplane_offload = DataPlaneOffload(metrics=metrics)
         # Bound once: _decode_fresh is per-incoming-frame hot.
         self._utilization_timer = (
             metrics.utilization_timer
@@ -253,6 +257,7 @@ class NetworkSyncer:
             if t is not asyncio.current_task():
                 t.cancel()
         self.dispatcher.stop()
+        self.dataplane_offload.stop()
         for c in self.connections.values():
             c.close()
         if hasattr(self.network, "stop"):
@@ -689,19 +694,31 @@ class NetworkSyncer:
         tracer = spans.active()
         t_recv = tracer.now() if tracer is not None else 0.0
         timer = self._utilization_timer
-        blocks: List[StatementBlock] = []
-        malformed = 0
-        with timer("net:decode"):
-            for raw in serialized_blocks:
-                try:
-                    block = StatementBlock.from_bytes(raw)
-                except Exception:
-                    log.warning("dropping malformed block bytes from peer")
-                    malformed += 1
-                    continue  # malformed: drop (byzantine peer)
-                blocks.append(block)
-        if malformed and peer is not None:
-            self._count_invalid(peer, "malformed", malformed)
+        offload = self.dataplane_offload
+        if offload is not None and offload.should_offload(
+            sum(len(raw) for raw in serialized_blocks)
+        ):
+            # Big batch + native extension + real node: decode all blocks
+            # and hash all digests/signature-prehashes on the offload
+            # worker, one GIL round-trip for the whole frame; the event
+            # loop keeps scheduling meanwhile.  Stage time lands on
+            # utilization_timer{proc="offload:decode"} (measured in the
+            # worker) rather than net:decode.  Sims never take this branch
+            # (offload inactive) — the inline path below introduces no new
+            # awaits, keeping seeded schedules byte-identical.
+            decoded = await offload.run(
+                "decode", StatementBlock.from_bytes_many, serialized_blocks
+            )
+        else:
+            with timer("net:decode"):
+                decoded = StatementBlock.from_bytes_many(serialized_blocks)
+        blocks: List[StatementBlock] = [b for b in decoded if b is not None]
+        malformed = len(decoded) - len(blocks)
+        if malformed:
+            log.warning("dropping %d malformed block payload(s) from peer",
+                        malformed)
+            if peer is not None:
+                self._count_invalid(peer, "malformed", malformed)
         if not blocks:
             return []
         # Dedup through the core task before paying for verification.

@@ -312,6 +312,25 @@ NetworkMessage = object
 
 
 def encode_message(msg: NetworkMessage) -> bytes:
+    if _native_encode_frame is not None:
+        # Native whole-frame serialization for the Blocks-shaped fan-out
+        # payloads (tags 2/4/12): one call builds the entire body with the
+        # GIL released instead of a per-block Writer append loop.
+        # Byte-identical to the Writer path below — pinned by the golden
+        # corpus and the data-plane parity suite.  Exact type checks: a
+        # TimestampedBlocks IS a Blocks (subclass), so dispatch must not
+        # collapse the stamped header.
+        t = type(msg)
+        if t is Blocks or t is RequestBlocksResponse:
+            return _native_encode_frame(
+                _MSG_BLOCKS if t is Blocks else _MSG_RESPONSE,
+                False, 0, 0, msg.blocks,
+            )
+        if t is TimestampedBlocks:
+            return _native_encode_frame(
+                _MSG_BLOCKS_TIMESTAMPED, True,
+                msg.sent_monotonic_ns, msg.sent_wall_ns, msg.blocks,
+            )
     w = Writer()
     if isinstance(msg, SubscribeOwnFrom):
         w.u8(_MSG_SUBSCRIBE).u64(msg.round)
@@ -390,6 +409,25 @@ def decode_message(data) -> NetworkMessage:
     materializes each exactly once for the canonical cache.  Everything
     else (references, digests, the snapshot manifest) is materialized here.
     """
+    if _native_parse_spans is not None and len(data) > 0 \
+            and data[0] in _NATIVE_PARSE_TAGS:
+        # Native batched parse for the Blocks-shaped payloads: the whole
+        # body is validated in C (GIL released for the walk) and only the
+        # per-block sub-views are built in Python — the last step that
+        # must touch Python objects.  Rejection cases and error messages
+        # are byte-identical to the Reader path (parity corpus).
+        try:
+            tag, mono_ns, wall_ns, spans = _native_parse_spans(data)
+        except ValueError as exc:
+            raise SerdeError(str(exc)) from None
+        blocks = tuple(data[off : off + ln] for off, ln in spans)
+        if tag == _MSG_BLOCKS:
+            return Blocks(blocks)
+        if tag == _MSG_RESPONSE:
+            return RequestBlocksResponse(blocks)
+        return TimestampedBlocks(
+            blocks, sent_monotonic_ns=mono_ns, sent_wall_ns=wall_ns
+        )
     r = Reader(data)
     tag = r.u8()
     if tag == _MSG_SUBSCRIBE:
@@ -861,22 +899,45 @@ class _FrameReceiver(asyncio.BufferedProtocol):
         self._start, self._have = 0, tail
 
     def _parse(self) -> None:
-        buf, start, have = self._buf, self._start, self._have
-        while have - start >= 4:
-            length = int.from_bytes(buf[start : start + 4], "little")
-            if length > MAX_FRAME:
+        if _native_split_frames is not None:
+            # Native batch split: one call walks the whole assembly buffer
+            # and returns every complete frame's (offset, length) span; only
+            # the memoryview wrapping — the step that must touch Python
+            # objects — stays here.  All slices share one managed buffer,
+            # which keeps the `_views_exported` refcount probe truthful
+            # (any live slice pins the bytearray's refcount above 2).
+            spans, start, oversized = _native_split_frames(
+                self._buf, self._start, self._have, MAX_FRAME
+            )
+            if oversized:
                 self._exc = SerdeError(
-                    f"frame of {length} bytes exceeds MAX_FRAME"
+                    f"frame of {oversized} bytes exceeds MAX_FRAME"
                 )
                 self._wake()
                 self._transport.close()
                 return
-            end = start + 4 + length
-            if end > have:
-                break
-            self._frames.append(memoryview(buf)[start + 4 : end])
-            start = end
-        self._start = start
+            if spans:
+                view = memoryview(self._buf)
+                for off, length in spans:
+                    self._frames.append(view[off : off + length])
+            self._start = start
+        else:
+            buf, start, have = self._buf, self._start, self._have
+            while have - start >= 4:
+                length = int.from_bytes(buf[start : start + 4], "little")
+                if length > MAX_FRAME:
+                    self._exc = SerdeError(
+                        f"frame of {length} bytes exceeds MAX_FRAME"
+                    )
+                    self._wake()
+                    self._transport.close()
+                    return
+                end = start + 4 + length
+                if end > have:
+                    break
+                self._frames.append(memoryview(buf)[start + 4 : end])
+                start = end
+            self._start = start
         if self._frames:
             self._wake()
             if (
@@ -1149,3 +1210,25 @@ class TcpNetwork:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+
+
+# Native data-plane wiring (mirrors types.py's decoder gate): resolve the
+# batched frame helpers once, behind the `native is None` fallback contract
+# the native-fallback lint rule enforces.  Each alias is None when the
+# extension (or the specific function — build skew) is absent, and every
+# call site above branches on that.
+from .native import native as _native_mod  # noqa: E402
+
+_NATIVE_PARSE_TAGS = frozenset(
+    (_MSG_BLOCKS, _MSG_RESPONSE, _MSG_BLOCKS_TIMESTAMPED)
+)
+_native_encode_frame = None
+_native_parse_spans = None
+_native_split_frames = None
+if _native_mod is not None:
+    if hasattr(_native_mod, "encode_blocks_frame"):
+        _native_encode_frame = _native_mod.encode_blocks_frame
+    if hasattr(_native_mod, "parse_blocks_spans"):
+        _native_parse_spans = _native_mod.parse_blocks_spans
+    if hasattr(_native_mod, "split_frames"):
+        _native_split_frames = _native_mod.split_frames

@@ -110,6 +110,13 @@ FRAME_SUBSYSTEMS: Dict[Tuple[str, str], str] = {
     ("storage", "gc_target"): "gc",
     ("block_store", "cleanup"): "gc",
     ("block_store", "retire_below_round"): "gc",
+    # Wire-block decode is mesh-parse cost wherever it bottoms out — the
+    # leaf-most in-package frame would otherwise charge it to "core"
+    # (types.py's module row).  Covers both the inline receive path and
+    # the dataplane-offload worker; WAL-reload decode rides along (decode
+    # is decode).
+    ("types", "from_bytes"): "mesh-parse",
+    ("types", "from_bytes_many"): "mesh-parse",
 }
 
 # Leaf frames that mean "this thread is parked, not burning CPU": the event
@@ -165,10 +172,16 @@ def attribute(frames: Sequence[Tuple[str, str, bool]]) -> str:
 
 def thread_class_of(name: str) -> str:
     """Coarse thread taxonomy for the cpu-seconds label: the event-loop
-    owner, verifier executor/JAX dispatch threads, the WAL writer, rest."""
+    owner, the data-plane offload worker, verifier executor/JAX dispatch
+    threads, the WAL writer, rest."""
     if name == "MainThread":
         return "loop"
     low = name.lower()
+    # Before the generic "threadpool" catch: the offload pool's threads are
+    # named dataplane-offload_N (core_task.DataPlaneOffload) and carry
+    # decode/digest burn, not signature verification.
+    if "offload" in low:
+        return "offload"
     if "verif" in low or "jax" in low or "threadpool" in low:
         return "verifier"
     if "wal" in low or "fsync" in low:
@@ -677,9 +690,21 @@ def write_report_from_env() -> Optional[str]:
     if not path or _active is None or _active.accountant is None:
         return None
     path = path.replace("%p", str(os.getpid()))
+    # The written file carries the native data-plane inventory alongside
+    # the attribution numbers (A/B harnesses record which path the node
+    # ran); report_bytes() itself stays environment-independent — the
+    # seeded census pin in tests/test_hostattr.py covers it, not this.
+    doc = json.loads(_active.accountant.report_bytes())
+    try:
+        from .native import active_functions
+
+        doc["native_active"] = list(active_functions())
+    except Exception:  # noqa: BLE001 - inventory is best-effort evidence
+        pass
     tmp = f"{path}.tmp"
-    with open(tmp, "wb") as f:
-        f.write(_active.accountant.report_bytes())
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+        f.write("\n")
     os.replace(tmp, path)
     return path
 

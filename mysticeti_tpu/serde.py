@@ -76,6 +76,11 @@ class Reader:
     def _take(self, n: int):
         end = self.pos + n
         if end > len(self.data):
+            # Error text contract: the native batched parser
+            # (mysticeti_native.cpp parse_blocks_spans) reproduces this
+            # exact message — the data-plane parity corpus asserts torn
+            # frames are indistinguishable across the native/fallback
+            # paths, so any wording change here must land there too.
             raise SerdeError(
                 f"truncated input: need {n} bytes at {self.pos}, have {len(self.data)}"
             )
@@ -106,6 +111,8 @@ class Reader:
 
     def expect_done(self) -> None:
         if not self.done():
+            # Same contract as _take: the native parser emits this message
+            # verbatim for over-long Blocks payloads.
             raise SerdeError(f"trailing garbage: {len(self.data) - self.pos} bytes")
 
 

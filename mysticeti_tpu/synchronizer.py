@@ -252,6 +252,12 @@ class BlockDisseminator:
         if not blocks:
             return None, cursor, 0
         to_cursor = max(b.round() for b in blocks)
+        # The frame payload stays LAZY (EncodedFrame builds it on first
+        # wire access via network.encode_message): the sim delivers the
+        # message object and never serializes, while the TCP write path
+        # gets the native whole-frame encode (encode_blocks_frame — one
+        # GIL-released call per fan-out frame) when the extension is
+        # present, the Writer loop otherwise.  Byte-identical either way.
         frame = EncodedFrame(
             self._blocks_message(tuple(b.to_bytes() for b in blocks))
         )

@@ -320,6 +320,10 @@ class StatementBlock:
         # Concatenated 8-byte submission stamps, also decoder-precomputed
         # (the commit observer's latency input).
         "_stamps",
+        # blake2b-256 over signed_bytes, precomputed by the batched native
+        # digest path (from_bytes_many) or cached on first computation: the
+        # signature verifier re-derives it per block otherwise.
+        "_signed_digest",
     )
 
     def __init__(
@@ -344,6 +348,7 @@ class StatementBlock:
         self._bytes = _bytes
         self._share_runs = None
         self._stamps = None
+        self._signed_digest = None
         # True only on construction paths that DERIVED the reference digest
         # from the exact cached bytes (from_bytes): re-hashing the same
         # bytes in verify_structure would compare a hash with itself — at
@@ -396,17 +401,22 @@ class StatementBlock:
             epoch_marker, epoch,
         )
         unsigned = w.finish()
+        signed_digest = crypto.blake2b_256(unsigned)
         if signer is not None:
-            signature = signer.sign(crypto.blake2b_256(unsigned))
+            signature = signer.sign(signed_digest)
         else:
             signature = crypto.SIGNATURE_NONE
         full = unsigned + signature
         digest = crypto.blake2b_256(full)
         ref = BlockReference(authority, round_, digest)
-        return cls(
+        block = cls(
             ref, includes, statements, meta_creation_time_ns, epoch_marker, epoch,
             signature, _bytes=full,
         )
+        # The signing pre-hash IS signed_digest; keep it so self-verification
+        # (and the TPU verifier's message input) skips a redundant hash pass.
+        block._signed_digest = signed_digest
+        return block
 
     @classmethod
     def new_genesis(cls, authority: AuthorityIndex, epoch: Epoch = 0) -> "StatementBlock":
@@ -435,8 +445,13 @@ class StatementBlock:
 
         This fixed-width message is what makes the TPU batch verifier's SHA-512 input
         a constant shape (R || A || 32-byte digest = one 128-byte SHA-512 block).
+        Cached: the batched native decode path (``from_bytes_many``) precomputes it
+        alongside the block digest, one GIL round-trip per frame instead of one
+        hash pass per verified block.
         """
-        return crypto.blake2b_256(self.signed_bytes())
+        if self._signed_digest is None:
+            self._signed_digest = crypto.blake2b_256(self.signed_bytes())
+        return self._signed_digest
 
     # Decode memo, enabled ONLY by the deterministic simulator
     # (runtime/simulated.py): all N simulated validators live in one process
@@ -605,6 +620,59 @@ class StatementBlock:
             memo[block._bytes] = block
         return block
 
+    @classmethod
+    def from_bytes_many(cls, raws) -> List[Optional["StatementBlock"]]:
+        """Batched decode of N serialized blocks; ``None`` marks a malformed entry.
+
+        The receive-path sibling of ``from_bytes`` for whole-frame ingest
+        (net_sync._decode_fresh): all N block digests AND signature
+        pre-hashes are computed in ONE native call with the GIL released
+        (``block_digests``), so a K-block frame costs one GIL round-trip
+        instead of K hashlib calls.  Falls back to per-raw ``from_bytes``
+        when the extension is absent or the sim decode memo is active —
+        the memo path must stay byte-identical (and instance-identical)
+        under seeded simulation.
+        """
+        if _native_decode is None or _native_block_digests is None \
+                or cls._decode_memo is not None:
+            out = []
+            for data in raws:
+                try:
+                    out.append(cls.from_bytes(data))
+                except SerdeError:
+                    out.append(None)
+            return out
+        datas = [data if type(data) is bytes else bytes(data) for data in raws]
+        decoded = []
+        good = []
+        for data in datas:
+            try:
+                decoded.append(_native_decode(data))
+                good.append(data)
+            except ValueError:
+                decoded.append(None)
+        digests = iter(_native_block_digests(good))
+        out: List[Optional["StatementBlock"]] = []
+        for data, dec in zip(datas, decoded):
+            if dec is None:
+                out.append(None)
+                continue
+            # Unpack OUTSIDE any except (same contract as from_bytes): an
+            # arity mismatch means extension build skew, not bad wire data.
+            (authority, round_, includes, statements, meta_ns,
+             epoch_marker, epoch, signature, share_runs, stamps) = dec
+            digest, signed_digest = next(digests)
+            block = cls(
+                BlockReference(authority, round_, digest), tuple(includes),
+                tuple(statements), meta_ns, epoch_marker, epoch, signature,
+                _bytes=data, _digest_trusted=True,
+            )
+            block._share_runs = share_runs
+            block._stamps = stamps
+            block._signed_digest = signed_digest
+            out.append(block)
+        return out
+
     # -- accessors --
 
     def author(self) -> AuthorityIndex:
@@ -714,9 +782,14 @@ class VerificationError(ValueError):
 from .native import native as _native_mod  # noqa: E402
 
 _native_decode = None
+_native_block_digests = None
 if _native_mod is not None and hasattr(_native_mod, "decode_block"):
     _native_mod.decode_register(
         BlockReference, Share, Vote, VoteRange, TransactionLocator,
         TransactionLocatorRange,
     )
     _native_decode = _native_mod.decode_block
+if _native_mod is not None and hasattr(_native_mod, "block_digests"):
+    # Batched (digest, signed-prehash) pairs — differentially pinned against
+    # crypto.blake2b_256 by the data-plane parity corpus.
+    _native_block_digests = _native_mod.block_digests

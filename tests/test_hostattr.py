@@ -86,6 +86,7 @@ def test_thread_classes():
     assert thread_class_of("MainThread") == "loop"
     assert thread_class_of("ThreadPoolExecutor-0_0") == "verifier"
     assert thread_class_of("wal-writer") == "wal"
+    assert thread_class_of("dataplane-offload_0") == "offload"
     assert thread_class_of("mysterious") == "aux"
 
 

@@ -1053,3 +1053,117 @@ def test_analyze_paths_cache_roundtrip_and_parallel(tmp_path):
     assert len(third) == 5
     # And disabling the cache still produces the same verdict.
     assert len(analyze_paths([str(pkg)], root=root, use_cache=False)) == 5
+
+
+# -- rule 14: native-fallback -------------------------------------------------
+
+
+def test_native_fallback_positive_unguarded_call():
+    findings = run(
+        """
+        from .native import native
+
+        def scan(buf):
+            return native.wal_scan(buf)
+        """
+    )
+    assert rules_of(findings) == ["native-fallback"]
+    assert "wal_scan" in findings[0].message
+
+
+def test_native_fallback_positive_aliased_import():
+    findings = run(
+        """
+        from mysticeti_tpu.native import native as _native
+
+        def scan(buf):
+            return _native.frame_entry(buf)
+        """
+    )
+    assert rules_of(findings) == ["native-fallback"]
+
+
+def test_native_fallback_negative_is_not_none_gate():
+    findings = run(
+        """
+        from .native import native
+
+        def scan(buf):
+            if native is not None:
+                return native.wal_scan(buf)
+            return pure_scan(buf)
+        """
+    )
+    assert findings == []
+
+
+def test_native_fallback_negative_else_of_none_gate():
+    findings = run(
+        """
+        from .native import native as _native
+
+        def scan(buf):
+            if _native is None:
+                return pure_scan(buf)
+            else:
+                return _native.wal_scan(buf)
+        """
+    )
+    assert findings == []
+
+
+def test_native_fallback_negative_early_return_promotion():
+    findings = run(
+        """
+        from .native import native
+
+        def scan(buf):
+            if native is None:
+                return pure_scan(buf)
+            return native.wal_scan(buf)
+        """
+    )
+    assert findings == []
+
+
+def test_native_fallback_negative_hasattr_and_conjunction_gates():
+    findings = run(
+        """
+        from .native import native as _native
+
+        def scan(buf, end):
+            if _native is not None and end > 0:
+                return _native.wal_scan(buf, end)
+            if hasattr(_native, "frame_entry"):
+                return _native.frame_entry(buf)
+            return pure_scan(buf)
+        """
+    )
+    assert findings == []
+
+
+def test_native_fallback_positive_wrong_polarity_branch():
+    # The call sits in the None branch: exactly the crash the rule exists for.
+    findings = run(
+        """
+        from .native import native
+
+        def scan(buf):
+            if native is None:
+                return native.wal_scan(buf)
+            return pure_scan(buf)
+        """
+    )
+    assert rules_of(findings) == ["native-fallback"]
+
+
+def test_native_fallback_inline_suppression():
+    findings = run(
+        """
+        from .native import native
+
+        def scan(buf):
+            return native.wal_scan(buf)  # lint: ignore[native-fallback]
+        """
+    )
+    assert findings == []

@@ -204,6 +204,174 @@ def mesh_serialization(peers: int = 9, blocks: int = 50, txs: int = 16,
     }
 
 
+def dataplane_bench(blocks: int = 64, txs: int = 16, iters: int = 40) -> dict:
+    """Native data-plane microbench: frame encode / parse / digest, native
+    batched vs pure-Python per-block, on one realistic dissemination frame.
+
+    The three stages are exactly the receive/send hot path the r19 native
+    batch helpers cover: whole-frame encode (``encode_message`` on a
+    ``Blocks`` fan-out), whole-frame parse (``decode_message`` splitting the
+    payload into per-block views), and the per-block digest pair
+    (block digest + signature pre-hash, batched into ONE native call).  The
+    fallback rows force the pure interpreter path in-process by nulling the
+    module-level native aliases — same bytes, same objects, so the ratio is
+    the GIL-free batching win and nothing else.  Without the extension the
+    native rows are absent and the artifact records fallback-only numbers.
+    """
+    import time
+
+    import mysticeti_tpu.network as network_mod
+    import mysticeti_tpu.types as types_mod
+    from mysticeti_tpu import crypto
+    from mysticeti_tpu.committee import Committee
+    from mysticeti_tpu.native import native
+    from mysticeti_tpu.network import Blocks, decode_message, encode_message
+    from mysticeti_tpu.types import Share, StatementBlock
+
+    signers = Committee.benchmark_signers(4)
+    genesis = [StatementBlock.new_genesis(a).reference for a in range(4)]
+    parts = tuple(
+        StatementBlock.build(
+            0, 1 + i, genesis,
+            [Share(bytes(128) + i.to_bytes(4, "little"))] * txs,
+            signer=signers[0],
+        ).to_bytes()
+        for i in range(blocks)
+    )
+    msg = Blocks(parts)
+    payload = encode_message(msg)
+    frame_bytes = len(payload)
+    total_bytes = sum(len(p) for p in parts)
+
+    def timed(fn):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters
+
+    def py_digest_per_block():
+        for p in parts:
+            crypto.blake2b_256(p)
+            crypto.blake2b_256(p[:-64])
+
+    saved = (
+        network_mod._native_encode_frame,
+        network_mod._native_parse_spans,
+        types_mod._native_decode,
+        types_mod._native_block_digests,
+    )
+    try:
+        network_mod._native_encode_frame = None
+        network_mod._native_parse_spans = None
+        types_mod._native_decode = None
+        types_mod._native_block_digests = None
+        fb_encode = timed(lambda: encode_message(msg))
+        fb_parse = timed(lambda: decode_message(payload))
+        fb_digest = timed(py_digest_per_block)
+        fb_decode_many = timed(
+            lambda: StatementBlock.from_bytes_many(parts)
+        )
+    finally:
+        (network_mod._native_encode_frame, network_mod._native_parse_spans,
+         types_mod._native_decode, types_mod._native_block_digests) = saved
+
+    def us(seconds):
+        return round(seconds * 1e6, 1)
+
+    row = {
+        "metric": "native_dataplane",
+        "native_active": native is not None,
+        "blocks_per_frame": blocks,
+        "txs_per_block": txs,
+        "frame_bytes": frame_bytes,
+        "iters": iters,
+        "fallback": {
+            "encode_us": us(fb_encode),
+            "parse_us": us(fb_parse),
+            "digest_per_block_us": us(fb_digest),
+            "decode_many_us": us(fb_decode_many),
+            "encode_mb_s": round(frame_bytes / 1e6 / fb_encode, 1),
+            "parse_mb_s": round(frame_bytes / 1e6 / fb_parse, 1),
+            "digest_mb_s": round(total_bytes / 1e6 / fb_digest, 1),
+        },
+    }
+    if native is None:
+        return row
+
+    nat_encode = timed(lambda: encode_message(msg))
+    nat_parse = timed(lambda: decode_message(payload))
+    nat_digest = timed(lambda: native.block_digests(parts))
+    nat_digest_per_block = timed(
+        lambda: [native.block_digests([p]) for p in parts]
+    )
+    nat_decode_many = timed(lambda: StatementBlock.from_bytes_many(parts))
+    combined = (fb_encode + fb_parse + fb_digest) / max(
+        nat_encode + nat_parse + nat_digest, 1e-12
+    )
+    row["native"] = {
+        "encode_us": us(nat_encode),
+        "parse_us": us(nat_parse),
+        "digest_batched_us": us(nat_digest),
+        "digest_per_block_us": us(nat_digest_per_block),
+        "decode_many_us": us(nat_decode_many),
+        "encode_mb_s": round(frame_bytes / 1e6 / nat_encode, 1),
+        "parse_mb_s": round(frame_bytes / 1e6 / nat_parse, 1),
+        "digest_mb_s": round(total_bytes / 1e6 / nat_digest, 1),
+    }
+    row["speedups"] = {
+        "encode": round(fb_encode / nat_encode, 2),
+        "parse": round(fb_parse / nat_parse, 2),
+        "digest": round(fb_digest / nat_digest, 2),
+        # One GIL round-trip per frame vs one per block, both native: the
+        # batching win isolated from the C-vs-interpreter win.
+        "digest_batched_vs_per_block": round(
+            nat_digest_per_block / nat_digest, 2
+        ),
+        "decode_many": round(fb_decode_many / nat_decode_many, 2),
+        # The acceptance ratio: whole native hot path vs pure per-block.
+        "combined_encode_parse_digest": round(combined, 2),
+    }
+    return row
+
+
+def append_dataplane_trend(row: dict, round_: int) -> None:
+    """NODE_DATAPLANE trend family: every recorded value is higher-is-better
+    (MB/s and speedup ratios — the budget-row inversion PERF_ATTR uses for
+    per-leader costs), so the stock >10%-below-best regression gate applies
+    directly round-over-round."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench_trend
+
+    source = f"DATAPLANE_r{round_:02d}.json"
+    fresh = []
+
+    def rec(metric, value, unit):
+        fresh.append(bench_trend._record(
+            round_, source, f"NODE_DATAPLANE.{metric}", value, unit,
+        ))
+
+    rec("fallback_encode_mb_s", row["fallback"]["encode_mb_s"], "MB/s")
+    rec("fallback_parse_mb_s", row["fallback"]["parse_mb_s"], "MB/s")
+    rec("fallback_digest_mb_s", row["fallback"]["digest_mb_s"], "MB/s")
+    if row.get("native"):
+        rec("native_encode_mb_s", row["native"]["encode_mb_s"], "MB/s")
+        rec("native_parse_mb_s", row["native"]["parse_mb_s"], "MB/s")
+        rec("native_digest_mb_s", row["native"]["digest_mb_s"], "MB/s")
+        sp = row["speedups"]
+        rec("encode_speedup", sp["encode"], "x")
+        rec("parse_speedup", sp["parse"], "x")
+        rec("digest_speedup", sp["digest"], "x")
+        rec("digest_batched_vs_per_block", sp["digest_batched_vs_per_block"],
+            "x")
+        rec("decode_many_speedup", sp["decode_many"], "x")
+        rec("combined_speedup", sp["combined_encode_parse_digest"], "x")
+    path = os.environ.get("BENCH_TREND_PATH", "BENCH_TREND.json")
+    index = bench_trend.load_index(path)
+    if bench_trend.merge_index(index, fresh):
+        bench_trend.write_index(index, path)
+
+
 def append_mesh_trend(row: dict, round_: int) -> None:
     """Track the fan-out win round-over-round in BENCH_TREND.json under its
     own MESH_SERIALIZATION family (never mixed with the fleet families —
@@ -251,10 +419,24 @@ def main() -> None:
         "MESH_SERIALIZATION family",
     )
     parser.add_argument(
+        "--dataplane-bench", action="store_true",
+        help="run ONLY the native data-plane microbench (frame encode/parse"
+        "/digest, native batched vs pure-Python per-block) and append it to "
+        "BENCH_TREND.json under the NODE_DATAPLANE family",
+    )
+    parser.add_argument(
         "--round", type=int, default=10,
-        help="PR round recorded with --mesh-bench trend records",
+        help="PR round recorded with --mesh-bench/--dataplane-bench trend "
+        "records",
     )
     args = parser.parse_args()
+
+    if args.dataplane_bench:
+        row = dataplane_bench()
+        print(json.dumps(row, indent=2))
+        append_dataplane_trend(row, args.round)
+        print("appended NODE_DATAPLANE records to BENCH_TREND.json")
+        return
 
     if args.mesh_bench:
         row = mesh_serialization()

@@ -98,7 +98,9 @@ def scrape_node(host: str, port: int) -> Optional[dict]:
         elif name == "mysticeti_health_slo_alerts_total":
             kind = labels.get("kind", "?")
             out["slo_alerts"][kind] = out["slo_alerts"].get(kind, 0.0) + value
-    health = _http_get(host, port, "/health")
+    # /health is served on the node's event loop, so under saturating load
+    # it lags far behind the thread-served /metrics route — give it room.
+    health = _http_get(host, port, "/health", timeout=10.0)
     if health:
         try:
             doc = json.loads(health)
@@ -197,6 +199,19 @@ def aggregate(
         "committed_leaders_by_node": {
             k: int(v["leaders"]) for k, v in sorted(live.items())
         },
+        # Which native data-plane functions each node resolved: A/B
+        # artifacts (tools/dataplane_ab.py) record which path a fleet
+        # actually measured.  The shutdown report is authoritative (it is
+        # written even when load kept /health from ever answering); the
+        # live scrape's host block is the fallback.
+        "native_active_by_node": {
+            k: (
+                (reports.get(k) or {}).get("native_active")
+                if (reports.get(k) or {}).get("native_active") is not None
+                else (v.get("host") or {}).get("native_active")
+            )
+            for k, v in sorted(live.items())
+        },
     }
 
 
@@ -241,13 +256,41 @@ def run_fleet(args) -> dict:
             cwd=_REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
         ))
     scrapes: Dict[str, Optional[dict]] = {}
+    # Boot probe: one scrape right after launch, before any INITIAL_DELAY
+    # load lands.  It snapshots the counter window start (so cumulative
+    # cpu/leader gauges can be re-windowed to the loaded interval) and is
+    # usually the only /health capture that succeeds when the load later
+    # saturates the event loop.
+    first_scrapes: Dict[str, dict] = {}
     deadline = time.time() + args.duration
     try:
+        # Two probe passes: the first often lands mid-boot (verifier
+        # warmup keeps the loop too busy for /health), so the second is
+        # both the /health retry and the real window start — each pass
+        # overwrites first_scrapes, carrying any captured host block.
+        for _ in range(2):
+            time.sleep(min(3.0, max(0.5, args.duration / 8.0)))
+            for idx, (host, port) in enumerate(targets):
+                scrape = scrape_node(host, port)
+                if scrape is None:
+                    continue
+                prev = first_scrapes.get(str(idx))
+                if scrape.get("host") is None and prev is not None:
+                    scrape["host"] = prev.get("host")
+                first_scrapes[str(idx)] = scrape
+                scrapes[str(idx)] = scrape
         while time.time() < deadline - 1.0:
             time.sleep(min(args.scrape_interval, max(0.5, deadline - time.time() - 1.0)))
             for idx, (host, port) in enumerate(targets):
                 scrape = scrape_node(host, port)
                 if scrape is not None:
+                    prev = scrapes.get(str(idx))
+                    if scrape.get("host") is None and prev is not None:
+                        # /health can still time out when the loop is
+                        # saturated; the host block (native inventory,
+                        # thread census) barely moves, so keep the last
+                        # one we captured rather than dropping it.
+                        scrape["host"] = prev.get("host")
                     scrapes[str(idx)] = scrape  # keep the freshest
     finally:
         for proc in procs:
@@ -266,6 +309,25 @@ def run_fleet(args) -> dict:
         except (OSError, ValueError):
             reports[str(i)] = None
     doc = aggregate(scrapes, reports)
+    # Re-window the cumulative cpu/leader counters to [boot probe, last
+    # scrape]: the node's own us_per_leader gauge averages from process
+    # start, so cheap pre-load boot rounds dilute it.  The windowed view
+    # is what load A/Bs (tools/dataplane_ab.py) compare.
+    windowed: Dict[str, Dict[str, float]] = {}
+    for key, last in scrapes.items():
+        head = first_scrapes.get(key)
+        if not last or not head or last is head:
+            continue
+        dleaders = last["leaders"] - head["leaders"]
+        if dleaders <= 0:
+            continue
+        windowed[key] = {
+            sub: round(
+                1e6 * (cpu - head["cpu_seconds"].get(sub, 0.0)) / dleaders, 1
+            )
+            for sub, cpu in last["cpu_seconds"].items()
+        }
+    doc["windowed_us_per_leader_by_node"] = windowed
     doc.update(
         metric="perf_attr",
         nodes=args.committee_size,
