@@ -172,6 +172,12 @@ GUARDED_FIELDS: Dict[str, str] = {
     # it is loop-thread-only and lock-free by design.)
     "_finality_pending": "_finality_lock",
     "_finality_samples": "_finality_lock",
+    # Execution account table (execution.ExecutionState): the core's commit
+    # fold mutates balances on the loop thread while ingress submit threads
+    # probe admission verdicts and checkpoint writers serialize the table —
+    # every reassignment/mutation outside __init__ must hold the execution
+    # lock or an admission probe reads a half-applied transfer.
+    "_exec_accounts": "_exec_lock",
 }
 
 # Rule 4: directories whose jitted functions must stay trace-pure.

@@ -322,6 +322,14 @@ class SafetyChecker:
         # epoch; a disagreement is a safety violation of the same class as
         # a commit fork (the committees diverge, then everything does).
         self._epochs: Dict[int, Dict[int, Tuple[int, bytes]]] = {}
+        # Execution plane (execution.py): per-authority state-root chain —
+        # height -> chained root after folding that commit.  Every honest
+        # node must derive the SAME root at every shared height; a
+        # disagreement means the replicated state machine diverged — the
+        # exact failure class execution-backed finality exists to rule out,
+        # and strictly stronger evidence than an anchor fork (same inputs,
+        # different outputs).
+        self._state_roots: Dict[int, Dict[int, bytes]] = {}
         # Committed-throughput accounting: transactions (Share statements)
         # AND blocks in each node's committed sub-dags, keyed observer ->
         # block author, counted once per height (a WAL-replay
@@ -397,6 +405,40 @@ class SafetyChecker:
     def epoch_of(self, authority: int) -> int:
         mine = self._epochs.get(authority)
         return max(mine) if mine else 0
+
+    def note_state_root(self, authority: int, height: int, root: bytes) -> None:
+        """Record the state root an authority derived by folding the commit
+        at ``height`` through the execution state machine.  A node
+        re-deriving a DIFFERENT root for a height it already executed —
+        e.g. across a crash-restart replay or a snapshot adoption — raises
+        immediately: determinism broke on ONE node before it could fork
+        the fleet."""
+        mine = self._state_roots.setdefault(authority, {})
+        root = bytes(root)
+        prev = mine.get(height)
+        if prev is not None and prev != root:
+            if authority in self.adversaries:
+                self._note_adversary_divergence(
+                    kind="state-root-self-conflict", adversary=authority,
+                    height=height,
+                )
+                mine[height] = root
+                return
+            violation = SafetyViolation(
+                f"authority {authority} executed height {height} twice with "
+                f"different roots: {prev.hex()[:16]} then {root.hex()[:16]}"
+            )
+            if self._violation is None:
+                self._violation = violation
+            raise violation
+        mine[height] = root
+
+    def executed_height(self, authority: int) -> int:
+        mine = self._state_roots.get(authority)
+        return max(mine) if mine else 0
+
+    def state_root_at(self, authority: int, height: int) -> Optional[bytes]:
+        return self._state_roots.get(authority, {}).get(height)
 
     def observe(self, authority: int, committed) -> None:
         """Record a node's freshly committed sub-dags (List[CommittedSubDag])."""
@@ -521,6 +563,33 @@ class SafetyChecker:
                 if prev is not None and prev[0] != entry:
                     self._note_adversary_divergence(
                         kind="epoch-fork", adversary=authority, epoch=epoch,
+                    )
+        # Execution state-root agreement (execution.py): every honest node
+        # that folded the commit at height H derived the same chained root
+        # — identical committed inputs produced identical replicated state.
+        # A disagreement here with AGREEING anchors is the worst failure
+        # this harness can detect: consensus held, determinism did not.
+        golden_roots: Dict[int, Tuple[bytes, int]] = {}
+        for authority in sorted(self._state_roots):
+            if authority in self.adversaries:
+                continue
+            for height, root in self._state_roots[authority].items():
+                prev = golden_roots.get(height)
+                if prev is None:
+                    golden_roots[height] = (root, authority)
+                elif prev[0] != root:
+                    raise SafetyViolation(
+                        f"state-root fork at height {height}: authority "
+                        f"{prev[1]} derived {prev[0].hex()[:16]}, authority "
+                        f"{authority} derived {root.hex()[:16]}"
+                    )
+        for authority in sorted(self.adversaries & set(self._state_roots)):
+            for height, root in self._state_roots[authority].items():
+                prev = golden_roots.get(height)
+                if prev is not None and prev[0] != root:
+                    self._note_adversary_divergence(
+                        kind="state-root-fork", adversary=authority,
+                        height=height,
                     )
 
 
@@ -730,6 +799,21 @@ class ChaosSimHarness:
                     a, records
                 )
             )
+        if core.execution is not None:
+            # Feed the state-root audit: heights already re-folded by this
+            # boot (recovery re-scan over the post-checkpoint commits),
+            # then every future fold via the listener.  A crash-restarted
+            # node thus re-asserts the SAME roots it derived before the
+            # crash — the self-conflict arm of note_state_root.
+            if core.execution.last_height > 0:
+                self.checker.note_state_root(
+                    authority, core.execution.last_height, core.execution.root
+                )
+            core.execution_listeners.append(
+                lambda result, a=authority: self.checker.note_state_root(
+                    a, result.height, result.root
+                )
+            )
         return node
 
     async def start(self) -> None:
@@ -838,6 +922,14 @@ class ChaosSimHarness:
         node = self.nodes[via]
         assert node is not None, f"authority {via} is down"
         node.core.block_handler.inject(change.to_bytes())
+
+    def inject(self, via: int, payload: bytes) -> None:
+        """Plant an arbitrary transaction payload on ``via``'s block handler
+        (the execution-plane workload rides the same next-own-proposal path
+        as committee changes)."""
+        node = self.nodes[via]
+        assert node is not None, f"authority {via} is down"
+        node.core.block_handler.inject(payload)
 
     async def stop(self) -> None:
         if self.health_monitor is not None:
@@ -1092,6 +1184,13 @@ class ChaosReport:
     # reconfigured.
     epochs: Dict[int, int] = field(default_factory=dict)
     epoch_boundaries: Dict[int, List] = field(default_factory=dict)
+    # Execution plane: each authority's highest executed height and the root
+    # it derived there, plus the honest fleet's agreed root chain
+    # (height -> root hex; the per-height agreement itself is the
+    # SafetyChecker's job — a state-root fork raises before this report is
+    # built).  Empty when the scenario never ran the execution plane.
+    executed: Dict[int, List] = field(default_factory=dict)
+    state_root_chain: Dict[int, str] = field(default_factory=dict)
 
     @staticmethod
     def _from_authors(
@@ -1297,6 +1396,25 @@ def run_chaos_sim(
                 epoch: [height, digest.hex()]
                 for table in harness.checker._epochs.values()
                 for epoch, (height, digest) in table.items()
+            },
+            executed={
+                a: [
+                    harness.checker.executed_height(a),
+                    (
+                        harness.checker.state_root_at(
+                            a, harness.checker.executed_height(a)
+                        )
+                        or b""
+                    ).hex(),
+                ]
+                for a in range(harness.n)
+                if harness.checker.executed_height(a) > 0
+            },
+            state_root_chain={
+                height: root.hex()
+                for a, table in sorted(harness.checker._state_roots.items())
+                if a not in harness.checker.adversaries
+                for height, root in table.items()
             },
         )
 

@@ -89,6 +89,10 @@ def _apply_storage_overrides(parameters: Parameters, args) -> None:
         ingress.admission_initial_tx_s = float(args.admission_initial)
     if getattr(args, "no_admission", False):
         ingress.admission = False
+    # Execution plane (execution.py): the deterministic account/transfer
+    # state machine folding the committed sequence.
+    if getattr(args, "execution", False):
+        parameters.execution = True
 
 
 async def run_node(
@@ -265,6 +269,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.add_argument("--mempool-max-transactions", type=int, default=None,
                        help="ingress mempool transaction cap (submissions "
                        "beyond it are SHED with a typed reject)")
+        p.add_argument("--execution", action="store_true",
+                       help="run the deterministic execution plane: fold "
+                            "committed transactions through the "
+                            "account/transfer state machine and serve the "
+                            "EXECUTED notification suffix (docs/execution.md)")
         p.add_argument("--admission-initial", type=float, default=None,
                        help="initial AIMD-admitted rate ceiling, tx/s")
 

@@ -204,6 +204,12 @@ class Parameters:
     # armed.  Off by default: pre-knob peers reset connections on the soft
     # tag, and the frozen-committee fast path skips the per-commit scan.
     reconfig: bool = False
+    # Deterministic execution plane (execution.py): fold every committed
+    # sub-dag through the account/transfer state machine and chain a
+    # per-commit state root.  Off by default: the fold costs a per-commit
+    # payload scan, and the checkpoint/manifest soft tail grows with the
+    # account table.
+    execution: bool = False
     # Legacy spellings of the storage block's knobs: accepted at construction
     # and in YAML for back-compat, migrated into ``storage`` by __post_init__
     # (which then rebinds these names to the storage block's values, so every

@@ -114,6 +114,18 @@ class Core:
             )
             committee = self.reconfig.committee
 
+        # Deterministic execution plane (execution.py): account/transfer
+        # state machine folded over the committed sequence.  A recovered
+        # state (checkpoint/snapshot soft tail) restores the exact root the
+        # node crashed out of; replayed commits below it are skipped by the
+        # fold's height guard.
+        self.execution = None
+        if parameters.execution:
+            from .execution import ExecutionState
+
+            self.execution = ExecutionState(metrics=metrics)
+            self.execution.recover(recovered.exec_state)
+
         if recovered.last_own_block is not None:
             # Recovery: replay pending includes into the clock (core.rs:89-95).
             for _, meta in pending:
@@ -179,16 +191,22 @@ class Core:
         # audits cross-node boundary agreement.  Registered post-construction
         # by the node assembly; fired on the consensus owner only.
         self.epoch_listeners: List = []
+        # Called per folded commit with the ExecutionResult: the ingress
+        # plane closes execute-phase finality and pushes gateway EXECUTED
+        # notifications, the chaos checker audits cross-node root agreement.
+        # Registered post-construction; fired on the consensus owner only.
+        self.execution_listeners: List = []
         # Historical-committee memo for committee_for_epoch (catch-up
         # validates every pre-boundary block against its own epoch).
         self._epoch_committees: Dict[int, Committee] = {}
         self.committer: UniversalCommitter = self._build_committer()
 
-        if self.reconfig is not None:
+        if self.reconfig is not None or self.execution is not None:
             # Crash landing between a boundary commit's WAL entry and the
             # next checkpoint: the replayed commits (everything after the
             # checkpoint baseline) are re-scanned so the node re-derives the
-            # exact epoch it crashed out of.
+            # exact epoch — and the exact execution root — it crashed out
+            # of.
             for commit in recovered.recovered_commits:
                 blocks = [
                     b
@@ -197,11 +215,15 @@ class Core:
                     )
                     if b is not None
                 ]
-                transition = self.reconfig.observe_commit(
-                    commit.height, commit.leader.round, blocks
-                )
-                if transition is not None:
-                    self._switch_epoch(transition)
+                if self.reconfig is not None:
+                    transition = self.reconfig.observe_commit(
+                        commit.height, commit.leader.round, blocks
+                    )
+                    if transition is not None:
+                        self._switch_epoch(transition)
+                if self.execution is not None:
+                    self.execution.observe_commit(commit.height, blocks)
+        if self.reconfig is not None:
             if metrics is not None:
                 metrics.mysticeti_epoch.set(self.committee.epoch)
                 metrics.mysticeti_committee_digest_info.labels(
@@ -527,6 +549,17 @@ class Core:
                 )
                 if transition is not None:
                     self._switch_epoch(transition)
+            if self.execution is not None:
+                # Fold the sub-dag into the account state machine and
+                # advance the root chain BEFORE the checkpoint below embeds
+                # the state — a checkpoint must never be ahead of or behind
+                # the commits it is anchored to.
+                result = self.execution.observe_commit(
+                    commit.height, commit.blocks
+                )
+                if result is not None:
+                    for listener in self.execution_listeners:
+                        listener(result)
         self.write_state()
         self.write_commits(commit_data, state)
         if self.storage is not None and commit_data:
@@ -582,6 +615,15 @@ class Core:
             transition = self.reconfig.adopt_chain(manifest.epoch_chain)
             if transition is not None:
                 self._switch_epoch(transition)
+        if self.execution is not None and manifest.exec_state:
+            # The manifest's execution state is the rejoiner's only source
+            # for the fold below the adopted baseline — without it the node
+            # would re-root at genesis and disagree with the fleet forever.
+            if self.execution.adopt(manifest.exec_state):
+                log.info(
+                    "adopted execution state: height %d, root %s",
+                    self.execution.last_height, self.execution.root.hex()[:16],
+                )
         return True
 
     def _raise_dag_floor(self, floor: RoundNumber) -> None:
@@ -630,6 +672,11 @@ class Core:
             # The epoch chain rides the manifest so a rejoiner absent across
             # boundaries lands on the CURRENT committee, not the genesis one.
             manifest.epoch_chain = self.reconfig.chain.to_bytes()
+        if self.execution is not None:
+            # Likewise the execution state: the rejoiner lands on the
+            # fleet's exact root instead of re-folding from genesis history
+            # it no longer has.
+            manifest.exec_state = self.execution.to_bytes()
         return manifest
 
     def wal_syncer(self) -> WalSyncer:

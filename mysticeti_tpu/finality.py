@@ -14,8 +14,11 @@ phase          interval
 ``proposal``   mempool accept → drained into a block proposal
 ``commit``     proposal inclusion → leader-sequence commit decision
 ``finalize``   commit decision → commit observer finalized the subdag
-``notify``     finalized → gateway commit notification queued
-``total``      submit → finalized (the headline SLI)
+``execute``    finalized → execution state machine folded the commit
+``notify``     finalized/executed → gateway commit notification queued
+``total``      submit → finalized — or submit → EXECUTED when the
+               execution plane is on (``execute_expected``): finality
+               then means results, not sequencing
 =============  =====================================================
 
 Cost is bounded by *content-based count sampling*: a key participates iff
@@ -46,7 +49,10 @@ DEFAULT_PENDING_CAP = 8192
 # Recent-sample window for the exact p50/p99 gauges.
 DEFAULT_SAMPLE_WINDOW = 512
 
-PHASES = ("admission", "proposal", "commit", "finalize", "notify", "total")
+PHASES = (
+    "admission", "proposal", "commit", "finalize", "execute", "notify",
+    "total",
+)
 
 
 def key_sampled(key: bytes, every: int) -> bool:
@@ -95,6 +101,11 @@ class FinalityTracker:
         self._finality_samples: Deque[float] = deque(maxlen=sample_window)
         self.completed = 0
         self.expired = 0
+        # Execution-backed finality: set by the ingress plane when the core
+        # runs the execution state machine.  The ``total`` SLI then closes
+        # at :meth:`on_execute` (results), not :meth:`on_commit`
+        # (sequencing).
+        self.execute_expected = False
 
     def sampled(self, key: bytes) -> bool:
         return key_sampled(key, self.sample_every)
@@ -133,8 +144,9 @@ class FinalityTracker:
         """A sampled key's transaction was committed (``t_commit`` = the
         commit decision, from the observer's entry clock) and finalized
         (``t_finalize`` = observer completion).  Completes the ``total``
-        sample; the entry stays (with the finalize stamp) so a later
-        gateway notification can close the ``notify`` phase."""
+        sample — unless ``execute_expected``, in which case the total
+        waits for :meth:`on_execute`; either way the entry stays so later
+        execute/notify stamps can close their phases."""
         with self._finality_lock:
             entry = self._finality_pending.get(key)
             if entry is None or "finalize" in entry:
@@ -143,11 +155,36 @@ class FinalityTracker:
             submit = entry["submit"]
             upstream = entry.get("proposal", entry["admitted"])
             total = t_finalize - submit
-            self._finality_samples.append(max(0.0, total))
-            self.completed += 1
+            if not self.execute_expected:
+                self._finality_samples.append(max(0.0, total))
+                self.completed += 1
         self._observe("commit", t_commit - upstream)
         self._observe("finalize", t_finalize - t_commit)
-        self._observe("total", total)
+        if not self.execute_expected:
+            self._observe("total", total)
+
+    def on_execute(self, keys: Iterable[bytes], t: float) -> None:
+        """Sampled keys' transactions were folded through the execution
+        state machine.  With the execution plane on this is where the
+        headline ``total`` SLI closes: a client waiting on the EXECUTED
+        notification waited for results, not sequencing."""
+        phases: List[float] = []
+        totals: List[float] = []
+        with self._finality_lock:
+            for key in keys:
+                entry = self._finality_pending.get(key)
+                if entry is None or "finalize" not in entry or "execute" in entry:
+                    continue
+                entry["execute"] = t
+                phases.append(t - entry["finalize"])
+                total = t - entry["submit"]
+                self._finality_samples.append(max(0.0, total))
+                self.completed += 1
+                totals.append(total)
+        for seconds in phases:
+            self._observe("execute", seconds)
+        for total in totals:
+            self._observe("total", total)
 
     def on_notify(self, keys: Iterable[bytes], t: float) -> None:
         """Sampled keys' commit notifications were queued to a gateway
@@ -158,9 +195,9 @@ class FinalityTracker:
                 entry = self._finality_pending.pop(key, None)
                 if entry is None or "finalize" not in entry:
                     continue
-                stamps.append(entry["finalize"])
-        for finalized in stamps:
-            self._observe("notify", t - finalized)
+                stamps.append(entry.get("execute", entry["finalize"]))
+        for done in stamps:
+            self._observe("notify", t - done)
 
     # -- views --
 

@@ -82,6 +82,23 @@ SHED_DUPLICATE = "duplicate"
 # truncation, now visible).  Counted on the same family so the whole
 # admitted-but-not-yet-proposed picture reads off one series.
 SHED_SOFT_CAP_DEFERRED = "soft_cap_deferred"
+# Execution-plane pre-consensus rejects (execution.py): typed verdicts for
+# transactions already doomed against current account state — shed here so
+# consensus never pays for them.  The label values ARE the execution
+# verdict names (one vocabulary across admission and the fold); the checks
+# are advisory (in-flight commits may move the account), so only verdicts
+# wrong against CURRENT state are shed — a nonce ahead of the account is
+# admitted and left to the deterministic fold.
+SHED_BAD_NONCE = "bad_nonce"
+SHED_INSUFFICIENT_BALANCE = "insufficient_balance"
+SHED_UNKNOWN_ACCOUNT = "unknown_account"
+SHED_ACCOUNT_EXISTS = "account_exists"
+_EXEC_SHED_REASONS = (
+    SHED_BAD_NONCE,
+    SHED_INSUFFICIENT_BALANCE,
+    SHED_UNKNOWN_ACCOUNT,
+    SHED_ACCOUNT_EXISTS,
+)
 
 # Floor on any retry-after hint: a zero tells a closed-loop client to spin.
 RETRY_AFTER_MIN_MS = 25
@@ -492,6 +509,16 @@ class IngressPlane:
         self._net_syncer = None
         self._block_verifier = None
         self._health = None
+        # Execution plane tap (attach(core=...) when core.execution is on):
+        # commit notifications are then DEFERRED until the core has folded
+        # the commit through the state machine — one frame carries both the
+        # sequencing decision and the executed root.  The buffer only lives
+        # between handle_commit and handle_committed_subdag in the same
+        # synchronous syncer pass, so it stays tiny.
+        self.execution = None
+        self._pending_exec: Deque[Tuple[int, List[bytes], dict]] = deque()
+        self.executed_height = 0
+        self.executed_root = b""
 
     # -- wiring --
 
@@ -504,6 +531,15 @@ class IngressPlane:
     ) -> "IngressPlane":
         if core is not None:
             self._core = core
+            execution = getattr(core, "execution", None)
+            if execution is not None:
+                self.execution = execution
+                self.executed_height = execution.last_height
+                self.executed_root = execution.root
+                core.execution_listeners.append(self._on_executed)
+                if self.finality is not None:
+                    # The headline total SLI now closes at EXECUTED.
+                    self.finality.execute_expected = True
         if net_syncer is not None:
             self._net_syncer = net_syncer
         if block_verifier is not None:
@@ -546,12 +582,19 @@ class IngressPlane:
         sheds: Dict[str, int] = {}
         if admitted_n < n:
             sheds[SHED_ADMISSION] = n - admitted_n
-        accepted, pool_sheds = self.mempool.submit(
-            client, transactions[:admitted_n], priority=priority,
-            t_submit=t_submit,
-        )
-        for reason, count in pool_sheds.items():
-            sheds[reason] = sheds.get(reason, 0) + count
+        admitted = transactions[:admitted_n]
+        if self.execution is not None:
+            lanes = self._route_execution(client, admitted, sheds)
+        else:
+            lanes = [(client, admitted)]
+        accepted = 0
+        for lane_client, lane_txs in lanes:
+            lane_accepted, pool_sheds = self.mempool.submit(
+                lane_client, lane_txs, priority=priority, t_submit=t_submit
+            )
+            accepted += lane_accepted
+            for reason, count in pool_sheds.items():
+                sheds[reason] = sheds.get(reason, 0) + count
         shed = n - accepted
         with self._accounting_lock:
             self.admitted_total += accepted
@@ -567,6 +610,7 @@ class IngressPlane:
                 SHED_MEMPOOL_TXS,
                 SHED_MEMPOOL_BYTES,
                 SHED_LANE_CAP,
+            ) + _EXEC_SHED_REASONS + (
                 SHED_DUPLICATE,
             ):
                 if candidate in sheds:
@@ -587,6 +631,38 @@ class IngressPlane:
             status = GATEWAY_QUEUED
         return SubmitResult(status, accepted, shed, retry_ms if shed else 0,
                             reason)
+
+    def _route_execution(
+        self, client: str, transactions: List[bytes], sheds: Dict[str, int]
+    ) -> List[Tuple[str, List[bytes]]]:
+        """Identity-backed fairness lanes + pre-consensus execution shed.
+
+        Execution transactions are re-laned by the ACCOUNT they spend from
+        (``acct:<key>``), not by the client-chosen lane token — one identity
+        hammering the pool through many connections still competes as one
+        lane, and one gateway fronting many identities no longer serializes
+        them behind a single token.  Transactions already doomed against
+        current account state (bad nonce, overdraft, unknown account,
+        CREATE of an existing account) are shed with a typed verdict BEFORE
+        consensus sequences them.  Non-execution payloads keep the caller's
+        lane untouched.  No locks are held here: ``admission_verdict`` takes
+        the execution lock internally and ``Mempool.submit`` is called after
+        (lock-order discipline).
+        """
+        from .execution import parse_exec_tx
+
+        lanes: "OrderedDict[str, List[bytes]]" = OrderedDict()
+        for tx in transactions:
+            parsed = parse_exec_tx(tx)
+            if parsed is None:
+                lanes.setdefault(client, []).append(tx)
+                continue
+            verdict = self.execution.admission_verdict(parsed)
+            if verdict is not None:
+                sheds[verdict] = sheds.get(verdict, 0) + 1
+                continue
+            lanes.setdefault(f"acct:{parsed.account.hex()}", []).append(tx)
+        return list(lanes.items())
 
     def drain(self, budget: int) -> List[bytes]:
         return self.mempool.drain(budget)
@@ -729,7 +805,7 @@ class IngressPlane:
                 for key in keys:
                     if fin.sampled(key):
                         fin.on_commit(key, t_commit, now)
-            if not self._commit_sinks:
+            if not self._commit_sinks and self.execution is None:
                 continue
             # Duck-typed commits (tests) may lack an anchor; default to 0.
             anchor = getattr(commit, "anchor", None)
@@ -737,12 +813,53 @@ class IngressPlane:
                 "leader_round": int(anchor.round) if anchor is not None else 0,
                 "committed_ts_ns": int(timestamp_utc() * 1e9),
             }
-            for sink in list(self._commit_sinks):
-                try:
-                    sink(commit.height, keys, info)
-                except Exception:  # noqa: BLE001 - a dead sink must not stall commits
-                    log.exception("ingress commit sink failed; removing")
-                    self.remove_commit_sink(sink)
+            if self.execution is not None:
+                # Defer: the syncer calls this observer feed BEFORE the core
+                # folds the commit through the execution state machine; the
+                # _on_executed listener flushes the notification with the
+                # executed root attached — same synchronous loop pass,
+                # microseconds later, but the client frame then carries
+                # RESULTS, not just sequencing.
+                self._pending_exec.append((commit.height, keys, info))
+                continue
+            self._dispatch(commit.height, keys, info)
+
+    def _dispatch(self, height: int, keys: List[bytes], info: dict) -> None:
+        for sink in list(self._commit_sinks):
+            try:
+                sink(height, keys, info)
+            except Exception:  # noqa: BLE001 - a dead sink must not stall commits
+                log.exception("ingress commit sink failed; removing")
+                self.remove_commit_sink(sink)
+
+    def _on_executed(self, result) -> None:
+        """Core execution listener: a committed sub-dag was folded.  Closes
+        the ``execute`` finality phase for sampled keys and flushes the
+        deferred commit notifications with the executed root attached
+        (stale buffered heights — possible only across a snapshot jump —
+        fall back to the recent-root window)."""
+        self.executed_height = result.height
+        self.executed_root = result.root
+        fin = self.finality
+        now = self.clock()
+        while self._pending_exec and self._pending_exec[0][0] <= result.height:
+            height, keys, info = self._pending_exec.popleft()
+            if height == result.height:
+                root = result.root
+            else:
+                root = self.execution.root_at(height) or result.root
+            info["executed_height"] = height
+            info["executed_root"] = root
+            if fin is not None:
+                fin.on_execute([k for k in keys if fin.sampled(k)], now)
+            self._dispatch(height, keys, info)
+        if self.recorder is not None and result.rejected:
+            self.recorder.record(
+                "exec-reject",
+                height=result.height,
+                rejected=result.rejected,
+                root=result.root.hex()[:16],
+            )
 
     # -- health / diagnosis --
 
@@ -762,6 +879,16 @@ class IngressPlane:
             **(
                 {"finality": self.finality.state()}
                 if self.finality is not None
+                else {}
+            ),
+            **(
+                {
+                    "execution": {
+                        "executed_height": self.execution.last_height,
+                        "executed_root": self.execution.root.hex(),
+                    }
+                }
+                if self.execution is not None
                 else {}
             ),
         }
@@ -886,16 +1013,25 @@ class IngressGateway:
                     # the detail suffix — a pre-r17 client would reset the
                     # connection on the longer frame otherwise.
                     want_details = bool(getattr(msg, "want_details", 0))
+                    # §5b second-tier extension (r20): want_executed adds
+                    # the EXECUTED result suffix (state root per commit)
+                    # and IMPLIES the detail suffix on the wire.
+                    want_executed = bool(getattr(msg, "want_executed", 0))
 
                     # Live stream only: from_height FILTERS future
                     # notifications, it does not replay commits that
                     # happened before the subscription (wire-format §5b
-                    # documents the gap contract for resuming clients).
+                    # documents the gap contract for resuming clients; the
+                    # synthetic executed-height notification below pins
+                    # where a resuming client's unknown window ends).
                     def sink(height, keys, info, q=outbound, fh=from_height,
-                             details=want_details):
+                             details=want_details, executed=want_executed):
                         if height <= fh:
                             return
-                        if details:
+                        root = (
+                            info.get("executed_root", b"") if executed else b""
+                        )
+                        if details or root:
                             note = GatewayCommitNotification(
                                 height,
                                 tuple(keys),
@@ -905,6 +1041,7 @@ class IngressGateway:
                                 committed_ts_ns=int(
                                     info.get("committed_ts_ns", 0)
                                 ),
+                                executed_root=root,
                             )
                         else:
                             note = GatewayCommitNotification(
@@ -930,6 +1067,20 @@ class IngressGateway:
                             )
 
                     self.plane.add_commit_sink(sink)
+                    if want_executed and self.plane.execution is not None:
+                        # Resume-gap fix: an immediate synthetic
+                        # notification (no keys) tells the subscriber
+                        # exactly where its unknown window ends — the
+                        # node's current executed height and root.  A
+                        # resuming client diffs this against its own last
+                        # known height before trusting the live stream.
+                        await outbound.put(
+                            GatewayCommitNotification(
+                                self.plane.execution.last_height,
+                                (),
+                                executed_root=self.plane.execution.root,
+                            )
+                        )
                 else:
                     log.warning(
                         "gateway conn %d sent non-gateway message %s; closing",

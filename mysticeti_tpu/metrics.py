@@ -653,8 +653,10 @@ class Metrics:
             "ingress keys: admission (submit -> mempool accept), proposal "
             "(accept -> drained into a block proposal), commit (proposal -> "
             "leader sequence commit), finalize (commit -> observer "
-            "finalized), notify (finalized -> gateway notification queued), "
-            "total (submit -> finalized)",
+            "finalized), execute (finalized -> execution state machine "
+            "folded the commit), notify (finalized/executed -> gateway "
+            "notification queued), total (submit -> finalized, or submit -> "
+            "EXECUTED when the execution plane is on)",
             labels=("phase",),
             buckets=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                      5.0, 10.0, 30.0],
@@ -680,6 +682,29 @@ class Metrics:
             "mysticeti_client_finality_p99_seconds",
             "rolling p99 of CLIENT-observed submit -> commit-notification "
             "latency from closed-loop generators",
+        )
+
+        # Deterministic execution plane (execution.py): the account/transfer
+        # state machine folded over the committed sequence.
+        self.mysticeti_execution_txs_total = counter(
+            "mysticeti_execution_txs_total",
+            "execution transactions folded through the state machine by "
+            "verdict: applied, or a typed deterministic reject "
+            "(bad_nonce, insufficient_balance, unknown_account, "
+            "account_exists) — rejects consume the commit slot but not "
+            "account state",
+            labels=("result",),
+        )
+        self.mysticeti_execution_height = gauge(
+            "mysticeti_execution_height",
+            "highest commit height folded through the execution state "
+            "machine (trails the committed sequence by at most the "
+            "in-flight syncer pass; a growing gap means the fold stalled)",
+        )
+        self.mysticeti_execution_accounts = gauge(
+            "mysticeti_execution_accounts",
+            "live accounts in the execution state machine's balance table "
+            "(checkpoint tail size scales with this)",
         )
 
         # Robustness / chaos engineering.

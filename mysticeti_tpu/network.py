@@ -263,10 +263,15 @@ class GatewaySubscribeCommits:
     ``want_details`` (soft suffix, wire-format §5b) opts the subscriber in
     to the tag-16 detail suffix (leader round + commit timestamp) — an
     opt-in because a pre-r17 client would reset the connection on the
-    longer notification frames (§7)."""
+    longer notification frames (§7).  ``want_executed`` (second-tier soft
+    suffix, r20) additionally opts in to the EXECUTED result suffix (the
+    state root after the execution plane folded the commit) and, on the
+    wire, forces the ``want_details`` byte to be written explicitly —
+    suffix tiers are strictly ordered."""
 
     from_height: int
     want_details: int = 0
+    want_executed: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,12 +283,22 @@ class GatewayCommitNotification:
     (wire-format §5b): the sequencing leader's round and the node's
     runtime commit timestamp, so clients compute finality without
     scraping ``/metrics``.  Encoded only when nonzero AND the subscriber
-    asked (``want_details``); absent on the wire they decode as 0."""
+    asked (``want_details``); absent on the wire they decode as 0.
+
+    ``executed_root`` is the second-tier EXECUTED result suffix (r20): the
+    execution plane's chained state root after folding this commit —
+    non-empty only for ``want_executed`` subscribers on nodes running the
+    execution state machine.  Writing it forces the detail pair onto the
+    wire (tiers are strictly ordered); absent it decodes as ``b""``.  A
+    notification with ``height > 0`` and NO keys is the synthetic resume
+    reply: it pins the node's current executed height/root for a
+    resuming subscriber."""
 
     height: int
     keys: Tuple[bytes, ...]
     leader_round: int = 0
     committed_ts_ns: int = 0
+    executed_root: bytes = b""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -382,18 +397,26 @@ def encode_message(msg: NetworkMessage) -> bytes:
         w.bytes(msg.reason)
     elif isinstance(msg, GatewaySubscribeCommits):
         w.u8(_MSG_GATEWAY_SUBSCRIBE_COMMITS).u64(msg.from_height)
-        # Soft suffix (§5b): omitted when default so pre-r17 gateways (and
-        # the roundtrip equality tests) see the original short frame.
-        if msg.want_details:
+        # Soft suffixes (§5b): omitted when default so pre-r17 gateways
+        # (and the roundtrip equality tests) see the original short frame.
+        # The second tier (want_executed, r20) forces the first byte to be
+        # written explicitly — a reader cannot skip a tier.
+        if msg.want_executed:
+            w.u8(1 if msg.want_details else 0).u8(1)
+        elif msg.want_details:
             w.u8(1)
     elif isinstance(msg, GatewayCommitNotification):
         w.u8(_MSG_GATEWAY_COMMITS).u64(msg.height).u32(len(msg.keys))
         for key in msg.keys:
             w.bytes(key)
-        # Soft suffix (§5b): leader round + commit timestamp, emitted only
+        # Soft suffixes (§5b): leader round + commit timestamp, emitted only
         # to subscribers that sent want_details (the gateway constructs
-        # default-0 notifications for everyone else).
-        if msg.leader_round or msg.committed_ts_ns:
+        # default-0 notifications for everyone else).  The EXECUTED result
+        # suffix (r20) forces the detail pair onto the wire even when zero.
+        if msg.executed_root:
+            w.u64(msg.leader_round).u64(msg.committed_ts_ns)
+            w.bytes(msg.executed_root)
+        elif msg.leader_round or msg.committed_ts_ns:
             w.u64(msg.leader_round).u64(msg.committed_ts_ns)
     else:  # pragma: no cover
         raise SerdeError(f"unknown message {type(msg)}")
@@ -477,16 +500,21 @@ def decode_message(data) -> NetworkMessage:
         )
     elif tag == _MSG_GATEWAY_SUBSCRIBE_COMMITS:
         from_height = r.u64()
-        # §5b suffix: absent on frames from pre-r17 clients.
-        msg = GatewaySubscribeCommits(
-            from_height, r.u8() if not r.done() else 0
-        )
+        # §5b suffixes, tier by tier: absent on frames from older clients.
+        want_details = r.u8() if not r.done() else 0
+        want_executed = r.u8() if not r.done() else 0
+        msg = GatewaySubscribeCommits(from_height, want_details, want_executed)
     elif tag == _MSG_GATEWAY_COMMITS:
         height = r.u64()
         keys = tuple(bytes(r.bytes()) for _ in range(r.u32()))
         if not r.done():
-            # §5b suffix: leader round + commit timestamp.
-            msg = GatewayCommitNotification(height, keys, r.u64(), r.u64())
+            # §5b suffixes: leader round + commit timestamp, then the
+            # optional EXECUTED result root (r20).
+            leader_round, committed_ts_ns = r.u64(), r.u64()
+            executed_root = bytes(r.bytes()) if not r.done() else b""
+            msg = GatewayCommitNotification(
+                height, keys, leader_round, committed_ts_ns, executed_root
+            )
         else:
             msg = GatewayCommitNotification(height, keys)
     else:

@@ -58,6 +58,8 @@ SUBSYSTEMS: Dict[str, str] = {
     "dag": "core", "lock": "core", "tasks": "core", "epoch_close": "core",
     # Epoch reconfiguration: the fold runs inline on the core commit path.
     "reconfig": "core",
+    # Execution state machine: folded inline on the core commit path.
+    "execution": "core",
     # Commit linearization + interpretation.
     "linearizer": "linearizer", "base_committer": "linearizer",
     "universal_committer": "linearizer", "commit_observer": "linearizer",

@@ -175,6 +175,16 @@ class Scenario:
     # Reconfig gate: the honest fleet must reach at least this epoch by the
     # end of the attacked run (0 = no gate).
     min_epoch: int = 0
+    # Execution plane (execution.py): arm Parameters.execution and drive a
+    # deterministic account/transfer workload in BOTH twins (execution is
+    # workload, not a fault) — every honest node must derive the SAME state
+    # root at every shared height or the SafetyChecker fails the run.
+    # Each injection batch is self-contained (CREATE a fresh account, then
+    # nonce-ordered TRANSFERs out of it in the same proposal), so batches
+    # commute across the committed interleaving and rejects stay
+    # deterministic.
+    execution: bool = False
+    exec_interval_s: float = 0.5
 
     def plan(self) -> FaultPlan:
         return FaultPlan(
@@ -203,6 +213,7 @@ class Scenario:
         return Parameters(
             leader_timeout_s=self.leader_timeout_s,
             reconfig=self.reconfig,
+            execution=self.execution,
             # Sim profile: rounds run ~0.1 s, so a 4-round liveness horizon
             # reacts to a silent leader within half a second (the
             # production default of 8 assumes real-network round times).
@@ -271,6 +282,13 @@ class Scenario:
                 churn=[event.to_dict() for event in self.churn],
                 min_epoch=self.min_epoch,
             )
+        if self.execution:
+            # Emitted only for execution scenarios so pre-r20 verdict
+            # documents stay byte-identical.
+            out.update(
+                execution=True,
+                exec_interval_s=self.exec_interval_s,
+            )
         return out
 
 
@@ -316,6 +334,59 @@ def _churn_driver(scenario: Scenario):
                 and harness.nodes[event.authority] is not None
             ):
                 await harness.retire(event.authority)
+
+    return driver
+
+
+# ---------------------------------------------------------------------------
+# Execution workload driver
+
+
+def _exec_driver(scenario: Scenario):
+    """Deterministic execution workload as a chaos ``extra_fault`` hook.
+
+    Every ``exec_interval_s`` of virtual time, each live non-adversary node
+    plants one SELF-CONTAINED transaction batch on its own block handler:
+    CREATE a fresh per-(node, batch) account, TRANSFER out of it twice in
+    nonce order, plus one deliberate overdraft (a deterministic typed
+    reject folded into the root like any other verdict).  Batches touch
+    disjoint accounts, so any committed interleaving applies identically —
+    the state-root chain is a pure function of the committed sequence, and
+    the SafetyChecker's per-height audit has real state to bite on."""
+    from .execution import ExecTx, OP_CREATE, OP_TRANSFER
+
+    async def driver(harness) -> None:
+        batch = 0
+        while True:
+            await asyncio.sleep(scenario.exec_interval_s)
+            batch += 1
+            for authority in range(scenario.nodes):
+                if (
+                    authority in harness.checker.adversaries
+                    or harness.nodes[authority] is None
+                ):
+                    continue
+                account = f"acct-{authority}-{batch}".encode()
+                sink = f"sink-{authority}".encode()
+                for tx in (
+                    ExecTx(OP_CREATE, account, amount=1000),
+                    ExecTx(OP_TRANSFER, account, nonce=1, amount=300,
+                           dest=sink),
+                    ExecTx(OP_TRANSFER, account, nonce=2, amount=300,
+                           dest=b"treasury"),
+                    # Overdraft on purpose: 400 left, 500 asked — the typed
+                    # reject is part of the deterministic workload.
+                    ExecTx(OP_TRANSFER, account, nonce=3, amount=500,
+                           dest=sink),
+                ):
+                    harness.inject(authority, tx.to_bytes())
+
+    return driver
+
+
+def _compose_drivers(drivers):
+    async def driver(harness) -> None:
+        await asyncio.gather(*(d(harness) for d in drivers))
 
     return driver
 
@@ -414,9 +485,15 @@ def run_scenario(
         ),
         absent=set(scenario.absent) or None,
     )
-    # The churn schedule runs in BOTH twins: membership change is part of
-    # the workload, so the clean baseline reconfigures identically.
-    churn = _churn_driver(scenario) if scenario.churn else None
+    # The churn schedule and the execution workload run in BOTH twins:
+    # membership change and state-machine load are part of the workload,
+    # so the clean baseline reconfigures and executes identically.
+    drivers = []
+    if scenario.churn:
+        drivers.append(_churn_driver(scenario))
+    if scenario.execution:
+        drivers.append(_exec_driver(scenario))
+    churn = _compose_drivers(drivers) if drivers else None
     attacked_dir = os.path.join(wal_root, f"{scenario.name}-attacked")
     clean_dir = os.path.join(wal_root, f"{scenario.name}-clean")
     os.makedirs(attacked_dir, exist_ok=True)
@@ -554,11 +631,48 @@ def run_scenario(
             },
             reconfig_ok=reconfig_ok,
         )
+    # Execution gate: every steady honest node folded real state (the
+    # per-height root agreement itself is the SafetyChecker's job — a
+    # state-root fork already failed safety_ok above).  The agreed root
+    # chain's digest is the artifact's determinism pin: same-seed runs
+    # must reproduce it byte-for-byte.
+    execution_ok = True
+    if scenario.execution:
+        steady = [
+            a
+            for a in range(scenario.nodes)
+            if a not in adversary_nodes and a not in crashed_nodes
+        ]
+        executed_heights = {
+            a: report.executed.get(a, [0, ""])[0] for a in steady
+        }
+        chain_bytes = json.dumps(
+            {str(h): r for h, r in sorted(report.state_root_chain.items())},
+            sort_keys=True,
+            separators=(",", ":"),
+        ).encode()
+        execution_ok = bool(report.state_root_chain) and all(
+            h > 0 for h in executed_heights.values()
+        )
+        verdict.update(
+            execution={
+                "executed_heights": {
+                    str(a): h for a, h in sorted(executed_heights.items())
+                },
+                "chain_length": len(report.state_root_chain),
+                "final_root": report.state_root_chain.get(
+                    max(report.state_root_chain, default=0), ""
+                ),
+                "root_chain_digest": _digest(chain_bytes),
+                "execution_ok": execution_ok,
+            }
+        )
     passed = (
         safety_ok
         and detections_ok
         and rejoins_ok
         and reconfig_ok
+        and execution_ok
         and ratio >= scenario.min_ratio
         and committed > 0
     )
@@ -894,8 +1008,70 @@ def reconfig_matrix() -> List[Scenario]:
     ]
 
 
+def execution_matrix() -> List[Scenario]:
+    """The execution-plane scenario family: the deterministic
+    account/transfer state machine folding the committed sequence under the
+    adversary matrix and under epoch churn.  Every honest node must derive
+    the same state-root chain (the SafetyChecker's per-height audit) and
+    the verdict pins the chain digest so same-seed runs must reproduce it
+    byte-for-byte."""
+    n = 10
+    return [
+        Scenario(
+            name="execution-byzantine-at-f",
+            description=(
+                "the byzantine-at-f adversary mix (equivocate + withhold + "
+                "invalid_sig at f=3 of 10) with the execution state "
+                "machine live: honest state roots must agree at every "
+                "shared height — consensus-level attacks must never "
+                "diverge replicated state"
+            ),
+            nodes=n,
+            duration_s=16.0,
+            seed=7,
+            leader_timeout_s=0.3,
+            adversaries=(
+                AdversarySpec(node=7, behavior="equivocate"),
+                AdversarySpec(node=8, behavior="withhold"),
+                AdversarySpec(node=9, behavior="invalid_sig"),
+            ),
+            execution=True,
+            min_ratio=0.5,
+        ),
+        Scenario(
+            name="execution-epoch-churn",
+            description=(
+                "execution workload across two epoch transitions (a stake "
+                "reweight and a clean REMOVE) under an equivocator: the "
+                "state-root chain must carry across committee switches "
+                "unbroken"
+            ),
+            nodes=n,
+            duration_s=18.0,
+            seed=18,
+            leader_timeout_s=0.3,
+            adversaries=(AdversarySpec(node=7, behavior="equivocate"),),
+            reconfig=True,
+            execution=True,
+            churn=(
+                ChurnEvent(
+                    at_s=4.0, kind=CHANGE_REWEIGHT, authority=2, stake=3
+                ),
+                ChurnEvent(
+                    at_s=9.0,
+                    kind=CHANGE_REMOVE,
+                    authority=8,
+                    follow_delay_s=2.5,
+                ),
+            ),
+            min_epoch=2,
+            min_ratio=0.5,
+        ),
+    ]
+
+
 def scenario_by_name(name: str) -> Scenario:
-    matrix = default_matrix() + reconfig_matrix()
+    matrix = default_matrix() + reconfig_matrix() + execution_matrix()
     for scenario in matrix:
         if scenario.name == name:
             return scenario

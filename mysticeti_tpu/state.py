@@ -91,6 +91,11 @@ class CoreRecoveredState:
     # the next checkpoint still reboots into the right epoch.
     epoch_chain: bytes = b""
     recovered_commits: List[CommitData] = field(default_factory=list)
+    # Execution plane (execution.py): the serialized account state from the
+    # recovering checkpoint/snapshot; Core re-folds the post-baseline
+    # ``recovered_commits`` on top so the node reboots onto the exact root
+    # it crashed out of.
+    exec_state: bytes = b""
 
 
 @dataclass
@@ -129,6 +134,7 @@ class RecoveredStateBuilder:
         self._replay_start: WalPosition = 0
         self._replayed_bytes = 0
         self._epoch_chain = b""
+        self._exec_state = b""
 
     def seed_checkpoint(self, checkpoint) -> None:
         """Boot the fold from a durable checkpoint instead of genesis: the
@@ -147,6 +153,7 @@ class RecoveredStateBuilder:
         self._checkpoint_height = checkpoint.commit_height
         self._replay_start = checkpoint.wal_position
         self._epoch_chain = checkpoint.epoch_chain
+        self._exec_state = checkpoint.exec_state
 
     def snapshot(self, manifest) -> None:
         """Fold a persisted snapshot-adoption entry (WAL_ENTRY_SNAPSHOT): the
@@ -162,6 +169,8 @@ class RecoveredStateBuilder:
         self._committed_sub_dags = []
         if manifest.epoch_chain:
             self._epoch_chain = manifest.epoch_chain
+        if manifest.exec_state:
+            self._exec_state = manifest.exec_state
 
     def note_replayed(self, replayed_bytes: int) -> None:
         self._replayed_bytes = replayed_bytes
@@ -227,6 +236,7 @@ class RecoveredStateBuilder:
             checkpoint_height=self._checkpoint_height,
             epoch_chain=self._epoch_chain,
             recovered_commits=list(self._committed_sub_dags),
+            exec_state=self._exec_state,
         )
         observer = CommitObserverRecoveredState(
             sub_dags=self._committed_sub_dags,

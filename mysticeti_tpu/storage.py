@@ -674,6 +674,11 @@ class Checkpoint:
     # (they decode as "still epoch 0") and omitted when empty, so frozen-
     # committee deployments keep byte-identical checkpoints.
     epoch_chain: bytes = b""
+    # Execution plane (execution.py): the serialized account state as of
+    # this checkpoint.  Second soft tail — writing it forces the epoch
+    # chain to be written explicitly (possibly empty) so the tail order is
+    # unambiguous; with both planes off the file stays byte-identical.
+    exec_state: bytes = b""
 
     def to_bytes(self) -> bytes:
         from .state import Include, encode_payload
@@ -708,7 +713,10 @@ class Checkpoint:
             w.u64(position)
             w.u8(1 if proposed else 0)
             ref.encode(w)
-        if self.epoch_chain:
+        if self.exec_state:
+            w.bytes(self.epoch_chain)
+            w.bytes(self.exec_state)
+        elif self.epoch_chain:
             w.bytes(self.epoch_chain)
         body = w.finish()
         return zlib.crc32(body).to_bytes(4, "little") + body
@@ -756,6 +764,7 @@ class Checkpoint:
             proposed = bool(r.u8())
             index.append((BlockReference.decode(r), position, proposed))
         epoch_chain = r.bytes() if not r.done() else b""
+        exec_state = r.bytes() if not r.done() else b""
         r.expect_done()
         return Checkpoint(
             wal_position=wal_position,
@@ -770,6 +779,7 @@ class Checkpoint:
             committed_refs=committed_refs,
             index=index,
             epoch_chain=epoch_chain,
+            exec_state=exec_state,
         )
 
 
@@ -853,6 +863,10 @@ class SnapshotManifest:
     # (omitted when empty), so pre-reconfig manifests stay byte-identical
     # and decode fine both ways.
     epoch_chain: bytes = b""
+    # Execution plane: the serving node's account state at the baseline —
+    # the rejoiner lands on the fleet's exact root.  Second soft tail with
+    # the same ordering rule as Checkpoint.exec_state.
+    exec_state: bytes = b""
 
     def to_bytes(self) -> bytes:
         w = Writer()
@@ -863,7 +877,10 @@ class SnapshotManifest:
         w.u32(len(self.committed_refs))
         for ref in self.committed_refs:
             ref.encode(w)
-        if self.epoch_chain:
+        if self.exec_state:
+            w.bytes(self.epoch_chain)
+            w.bytes(self.exec_state)
+        elif self.epoch_chain:
             w.bytes(self.epoch_chain)
         return w.finish()
 
@@ -881,6 +898,7 @@ class SnapshotManifest:
         chain_digest = r.fixed(32)
         refs = [BlockReference.decode(r) for _ in range(r.u32())]
         epoch_chain = r.bytes() if not r.done() else b""
+        exec_state = r.bytes() if not r.done() else b""
         r.expect_done()
         return SnapshotManifest(
             commit_height=commit_height,
@@ -889,6 +907,7 @@ class SnapshotManifest:
             chain_digest=chain_digest,
             committed_refs=refs,
             epoch_chain=epoch_chain,
+            exec_state=exec_state,
         )
 
 
@@ -1047,6 +1066,11 @@ class StorageLifecycle:
             epoch_chain=(
                 core.reconfig.chain.to_bytes()
                 if getattr(core, "reconfig", None) is not None
+                else b""
+            ),
+            exec_state=(
+                core.execution.to_bytes()
+                if getattr(core, "execution", None) is not None
                 else b""
             ),
         )

@@ -447,6 +447,11 @@ class Validator:
             "dropped": state["dropped"],
             "ledger_digest": ledger.digest(),
             "records": ledger.records(64),
+            **(
+                {"execution": core.execution.state()}
+                if core.execution is not None
+                else {}
+            ),
         }
 
     # -- production node (validator.rs:165-212) --

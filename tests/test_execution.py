@@ -1,0 +1,848 @@
+"""Deterministic execution plane: typed-transaction serde, the account state
+machine's apply/nonce/overdraft semantics, chained state roots, checkpoint /
+snapshot durability tails, execute-phase finality, the tag-15/16 EXECUTED
+wire suffixes, ingress identity lanes + typed pre-consensus sheds, and the
+seeded multi-node sims (adversary mix never diverges honest roots,
+crash-restart re-derives the same chain, a snapshot rejoiner lands on the
+fleet root, same-seed runs are byte-identical).  A planted wall-clock
+nondeterminism is caught statically (sim-taint) and dynamically (detsan)."""
+import asyncio
+import dataclasses
+import struct
+import time
+
+import pytest
+
+from mysticeti_tpu.config import (
+    IngressParameters,
+    Parameters,
+    StorageParameters,
+)
+from mysticeti_tpu.execution import (
+    APPLIED,
+    EXEC_MAGIC,
+    GENESIS_ROOT,
+    OP_CREATE,
+    OP_MINT,
+    OP_TRANSFER,
+    REJECT_BAD_NONCE,
+    REJECT_EXISTS,
+    REJECT_OVERDRAFT,
+    REJECT_UNKNOWN,
+    ExecTx,
+    ExecutionState,
+    parse_exec_tx,
+)
+from mysticeti_tpu.metrics import Metrics
+from mysticeti_tpu.types import Share
+
+pytestmark = pytest.mark.execution
+
+
+def _block(*txs):
+    class _Block:
+        def __init__(self, statements):
+            self.statements = statements
+
+    return _Block([Share(tx.to_bytes()) for tx in txs])
+
+
+def _fold(state, height, *txs):
+    return state.observe_commit(height, [_block(*txs)])
+
+
+# -- transaction serde --------------------------------------------------------
+
+
+def test_exec_tx_roundtrip_all_ops():
+    for tx in (
+        ExecTx(OP_CREATE, b"alice", amount=1000),
+        ExecTx(OP_MINT, b"alice", nonce=3, amount=7),
+        ExecTx(OP_TRANSFER, b"alice", nonce=4, amount=40, dest=b"bob"),
+    ):
+        data = tx.to_bytes()
+        assert data.startswith(EXEC_MAGIC)
+        assert ExecTx.from_bytes(data) == tx
+        assert parse_exec_tx(data) == tx
+
+
+def test_parse_ordinary_payloads_are_opaque():
+    # The benchmark workload (8/16-byte LE counters) and arbitrary client
+    # bytes must never parse as execution transactions.
+    for payload in (
+        struct.pack("<QQ", 0, 7) + b"\x00" * 496,
+        b"",
+        b"\x00" * 8,
+        b"ordinary transaction bytes",
+    ):
+        assert parse_exec_tx(payload) is None
+
+
+def test_parse_garbled_magic_is_opaque_not_an_error():
+    # Magic + junk: honest nodes must agree to IGNORE it, not fork on
+    # whether decoding raises.
+    assert parse_exec_tx(EXEC_MAGIC) is None
+    assert parse_exec_tx(EXEC_MAGIC + b"\xff\xff\xff") is None
+    # Trailing garbage after a valid encoding is garbled too.
+    valid = ExecTx(OP_MINT, b"a", nonce=1, amount=2).to_bytes()
+    assert parse_exec_tx(valid + b"\x00") is None
+
+
+def test_exec_tx_validation():
+    with pytest.raises(ValueError):
+        ExecTx(9, b"a")  # unknown op
+    with pytest.raises(ValueError):
+        ExecTx(OP_CREATE, b"")  # empty account key
+    with pytest.raises(ValueError):
+        ExecTx(OP_CREATE, b"a" * 65)  # oversize account key
+    with pytest.raises(ValueError):
+        ExecTx(OP_MINT, b"a", dest=b"b")  # dest on a non-transfer
+    with pytest.raises(ValueError):
+        ExecTx(OP_TRANSFER, b"a", dest=b"")  # transfer without dest
+
+
+# -- apply semantics ----------------------------------------------------------
+
+
+def test_create_mint_transfer_lifecycle():
+    st = ExecutionState()
+    assert st.root == GENESIS_ROOT and st.last_height == 0
+    result = _fold(
+        st,
+        1,
+        ExecTx(OP_CREATE, b"alice", amount=100),
+        ExecTx(OP_MINT, b"alice", nonce=1, amount=50),
+        ExecTx(OP_TRANSFER, b"alice", nonce=2, amount=40, dest=b"bob"),
+    )
+    assert result.applied == 3 and result.rejected == 0
+    assert st.probe(b"alice") == (110, 3)
+    # Transfer auto-creates the destination at nonce 0.
+    assert st.probe(b"bob") == (40, 0)
+    assert st.last_height == 1 and st.root != GENESIS_ROOT
+
+
+def test_typed_rejects_consume_nothing_but_count():
+    st = ExecutionState()
+    _fold(st, 1, ExecTx(OP_CREATE, b"alice", amount=10))
+    result = _fold(
+        st,
+        2,
+        ExecTx(OP_CREATE, b"alice", amount=5),  # exists
+        ExecTx(OP_MINT, b"ghost", nonce=0, amount=5),  # unknown
+        ExecTx(OP_MINT, b"alice", nonce=9, amount=5),  # wrong nonce
+        ExecTx(OP_TRANSFER, b"alice", nonce=1, amount=99, dest=b"b"),
+    )
+    assert result.applied == 0 and result.rejected == 4
+    assert dict(result.verdicts) == {
+        REJECT_EXISTS: 1,
+        REJECT_UNKNOWN: 1,
+        REJECT_BAD_NONCE: 1,
+        REJECT_OVERDRAFT: 1,
+    }
+    # Rejected transactions move no balances and consume no nonces...
+    assert st.probe(b"alice") == (10, 1)
+    assert st.probe(b"ghost") is None
+    # ...but the rejects are part of the deterministic fold: the root
+    # still advanced (height is digested even with zero deltas).
+    assert st.root != GENESIS_ROOT and st.last_height == 2
+
+
+def test_self_transfer_consumes_nonce_only():
+    st = ExecutionState()
+    _fold(st, 1, ExecTx(OP_CREATE, b"a", amount=10))
+    result = _fold(
+        st, 2, ExecTx(OP_TRANSFER, b"a", nonce=1, amount=5, dest=b"a")
+    )
+    assert result.applied == 1
+    assert st.probe(b"a") == (10, 2)
+
+
+def test_create_with_nonzero_nonce_rejected():
+    st = ExecutionState()
+    result = _fold(st, 1, ExecTx(OP_CREATE, b"a", nonce=3, amount=10))
+    assert dict(result.verdicts) == {REJECT_BAD_NONCE: 1}
+    assert st.probe(b"a") is None
+
+
+# -- root chaining ------------------------------------------------------------
+
+
+def test_root_chain_is_deterministic_across_replicas():
+    txs = [
+        ExecTx(OP_CREATE, b"a", amount=100),
+        ExecTx(OP_TRANSFER, b"a", nonce=1, amount=30, dest=b"b"),
+        ExecTx(OP_MINT, b"a", nonce=2, amount=5),
+    ]
+    a, b = ExecutionState(), ExecutionState()
+    for st in (a, b):
+        _fold(st, 1, txs[0])
+        _fold(st, 2, *txs[1:])
+    assert a.root == b.root
+    assert a.root_at(1) == b.root_at(1)
+    assert a.to_bytes() == b.to_bytes()
+
+
+def test_root_chain_depends_on_order_and_predecessor():
+    t1 = ExecTx(OP_CREATE, b"a", amount=100)
+    t2 = ExecTx(OP_CREATE, b"b", amount=100)
+    a, b = ExecutionState(), ExecutionState()
+    _fold(a, 1, t1)
+    _fold(a, 2, t2)
+    _fold(b, 1, t2)
+    _fold(b, 2, t1)
+    # Same final account table, different committed order: the CHAIN
+    # differs at height 1 (the root is a history commitment, not a state
+    # snapshot)...
+    assert a.root_at(1) != b.root_at(1)
+    # ...and stays different at height 2 through the prev-root chaining
+    # even though the tables now agree.
+    assert {k: v for k, v in a._exec_accounts.items()} == {
+        k: v for k, v in b._exec_accounts.items()
+    }
+    assert a.root != b.root
+
+
+def test_observe_commit_skips_replayed_heights():
+    st = ExecutionState()
+    _fold(st, 1, ExecTx(OP_CREATE, b"a", amount=10))
+    root = st.root
+    # Crash replay re-delivers committed heights: the fold must be
+    # idempotent (None return, nothing moves).
+    assert _fold(st, 1, ExecTx(OP_MINT, b"a", nonce=1, amount=99)) is None
+    assert st.root == root and st.probe(b"a") == (10, 1)
+
+
+# -- durability ---------------------------------------------------------------
+
+
+def test_to_bytes_recover_roundtrip_is_byte_exact():
+    st = ExecutionState()
+    _fold(st, 1, ExecTx(OP_CREATE, b"alice", amount=100))
+    _fold(
+        st, 2, ExecTx(OP_TRANSFER, b"alice", nonce=1, amount=30, dest=b"bob")
+    )
+    data = st.to_bytes()
+    twin = ExecutionState()
+    twin.recover(data)
+    assert twin.last_height == 2 and twin.root == st.root
+    assert twin.probe(b"alice") == (70, 2) and twin.probe(b"bob") == (30, 0)
+    assert twin.applied_total == st.applied_total
+    assert twin.to_bytes() == data
+    # Recovery resumes the chain exactly: the next fold lands on the same
+    # root on both.
+    nxt = ExecTx(OP_MINT, b"alice", nonce=2, amount=1)
+    _fold(st, 3, nxt)
+    _fold(twin, 3, nxt)
+    assert twin.root == st.root
+
+
+def test_adopt_only_moves_forward():
+    ahead, behind = ExecutionState(), ExecutionState()
+    _fold(ahead, 1, ExecTx(OP_CREATE, b"a", amount=10))
+    _fold(ahead, 2, ExecTx(OP_MINT, b"a", nonce=1, amount=5))
+    _fold(behind, 1, ExecTx(OP_CREATE, b"a", amount=10))
+    # A remote at or behind our height carries nothing: ignored.
+    assert not ahead.adopt(behind.to_bytes())
+    assert not ahead.adopt(ahead.to_bytes())
+    assert not ahead.adopt(b"")
+    # A remote ahead is adopted wholesale.
+    assert behind.adopt(ahead.to_bytes())
+    assert behind.last_height == 2 and behind.root == ahead.root
+
+
+def test_checkpoint_and_manifest_exec_state_soft_tail():
+    """With the plane off, checkpoint/manifest bytes are UNCHANGED from
+    pre-r20 (soft-tail contract, docs/wire-format.md §7); with it on, the
+    state rides both and round-trips exactly."""
+    from mysticeti_tpu.storage import SnapshotManifest
+
+    base = dict(
+        commit_height=7,
+        last_committed_leader=None,
+        gc_round=3,
+        chain_digest=b"\x11" * 32,
+    )
+    plain = SnapshotManifest(**base).to_bytes()
+    # Empty exec_state (and epoch_chain) encode NOTHING: byte-identical.
+    assert SnapshotManifest(**base, exec_state=b"").to_bytes() == plain
+
+    st = ExecutionState()
+    _fold(st, 7, ExecTx(OP_CREATE, b"a", amount=10))
+    carrying = SnapshotManifest(**base, exec_state=st.to_bytes())
+    decoded = SnapshotManifest.from_bytes(carrying.to_bytes())
+    assert decoded.exec_state == st.to_bytes()
+    assert decoded.epoch_chain == b""
+    # A pre-r20 decoder reading the plain frame sees no tail; a new
+    # decoder reading the plain frame sees empty state.
+    assert SnapshotManifest.from_bytes(plain).exec_state == b""
+
+
+# -- admission (pre-consensus) ------------------------------------------------
+
+
+def test_admission_verdict_sheds_only_currently_doomed():
+    st = ExecutionState()
+    _fold(st, 1, ExecTx(OP_CREATE, b"alice", amount=100))
+    # Already doomed against current state: typed sheds.
+    assert st.admission_verdict(ExecTx(OP_CREATE, b"alice")) == REJECT_EXISTS
+    assert (
+        st.admission_verdict(ExecTx(OP_MINT, b"ghost", nonce=0))
+        == REJECT_UNKNOWN
+    )
+    assert (
+        st.admission_verdict(ExecTx(OP_MINT, b"alice", nonce=0, amount=1))
+        == REJECT_BAD_NONCE  # stale: account nonce is already 1
+    )
+    assert (
+        st.admission_verdict(
+            ExecTx(OP_TRANSFER, b"alice", nonce=1, amount=500, dest=b"b")
+        )
+        == REJECT_OVERDRAFT
+    )
+    # Curable by in-flight traffic: admitted, the fold decides.
+    assert st.admission_verdict(ExecTx(OP_CREATE, b"bob", amount=5)) is None
+    assert (
+        st.admission_verdict(ExecTx(OP_MINT, b"alice", nonce=4, amount=1))
+        is None  # nonce ahead: earlier txs may be in flight
+    )
+    assert (
+        st.admission_verdict(
+            ExecTx(OP_TRANSFER, b"alice", nonce=1, amount=100, dest=b"b")
+        )
+        is None
+    )
+
+
+def test_metrics_verdict_labels_and_gauges():
+    metrics = Metrics()
+    st = ExecutionState(metrics=metrics)
+    _fold(
+        st,
+        1,
+        ExecTx(OP_CREATE, b"a", amount=10),
+        ExecTx(OP_MINT, b"a", nonce=9, amount=1),
+    )
+    counter = metrics.mysticeti_execution_txs_total
+    assert counter.labels(APPLIED)._value.get() == 1
+    assert counter.labels(REJECT_BAD_NONCE)._value.get() == 1
+    assert metrics.mysticeti_execution_height._value.get() == 1
+    assert metrics.mysticeti_execution_accounts._value.get() == 1
+
+
+# -- execute-phase finality ---------------------------------------------------
+
+
+def test_finality_total_closes_at_execute_when_expected():
+    from mysticeti_tpu.finality import FinalityTracker
+
+    metrics = Metrics()
+    tracker = FinalityTracker(metrics=metrics, sample_every=1)
+    tracker.execute_expected = True
+    key = b"k" * 16
+    tracker.on_submit(key, 1.0, 1.1)
+    tracker.on_commit(key, 2.0, 2.2)
+    # Committed but not executed: the headline total is still open.
+    assert tracker.completed == 0 and tracker.samples() == []
+    tracker.on_execute([key], 2.5)
+    assert tracker.completed == 1
+    assert tracker.samples() == [pytest.approx(1.5)]
+    # The execute phase is finalize -> execute; notify measures from the
+    # EXECUTE stamp, not finalize.
+    tracker.on_notify([key], 2.6)
+    hist = metrics.mysticeti_e2e_finality_seconds
+    assert hist.labels("execute")._sum.get() == pytest.approx(0.3)
+    assert hist.labels("notify")._sum.get() == pytest.approx(0.1)
+    assert hist.labels("total")._sum.get() == pytest.approx(1.5)
+
+
+def test_finality_total_closes_at_commit_without_execution():
+    from mysticeti_tpu.finality import FinalityTracker
+
+    tracker = FinalityTracker(sample_every=1)
+    key = b"k" * 16
+    tracker.on_submit(key, 1.0, 1.1)
+    tracker.on_commit(key, 2.0, 2.2)
+    assert tracker.completed == 1
+    assert tracker.samples() == [pytest.approx(1.2)]
+
+
+# -- wire suffixes (tags 15/16) -----------------------------------------------
+
+
+def test_subscribe_and_notification_suffix_tiers_roundtrip():
+    from mysticeti_tpu.network import (
+        GatewayCommitNotification,
+        GatewaySubscribeCommits,
+        decode_message,
+        encode_message,
+    )
+
+    root = b"\xab" * 32
+    for msg in (
+        # Tag 15: want_executed (tier 2) forces the want_details byte.
+        GatewaySubscribeCommits(5),
+        GatewaySubscribeCommits(5, want_details=1),
+        GatewaySubscribeCommits(5, want_details=0, want_executed=1),
+        GatewaySubscribeCommits(5, want_details=1, want_executed=1),
+        # Tag 16: executed_root (tier 2) forces the detail pair.
+        GatewayCommitNotification(4, (b"k" * 16,)),
+        GatewayCommitNotification(4, (b"k" * 16,), 9, 123456789),
+        GatewayCommitNotification(4, (b"k" * 16,), 0, 0, root),
+        GatewayCommitNotification(4, (b"k" * 16,), 9, 123456789, root),
+        # The synthetic resume reply: height, no keys, root only.
+        GatewayCommitNotification(17, (), executed_root=root),
+    ):
+        assert decode_message(encode_message(msg)) == msg
+    # Suffix-free frames are byte-identical to pre-r20: strictly shorter
+    # than any suffixed variant (a pre-r20 peer never sees new bytes
+    # unless it ASKED, wire-format §5b).
+    plain = encode_message(GatewaySubscribeCommits(5))
+    assert len(plain) < len(
+        encode_message(GatewaySubscribeCommits(5, want_executed=1))
+    )
+    note = encode_message(GatewayCommitNotification(4, (b"k" * 16,)))
+    assert len(note) < len(
+        encode_message(
+            GatewayCommitNotification(4, (b"k" * 16,), executed_root=root)
+        )
+    )
+
+
+# -- ingress: identity lanes, typed sheds, deferred notification --------------
+
+
+class _FakeCore:
+    """Just enough core surface for IngressPlane.attach(core=...)."""
+
+    def __init__(self, execution):
+        self.execution = execution
+        self.execution_listeners = []
+
+    def fold(self, height, blocks):
+        result = self.execution.observe_commit(height, blocks)
+        if result is not None:
+            for listener in self.execution_listeners:
+                listener(result)
+        return result
+
+
+def _plane_with_execution(**params):
+    from mysticeti_tpu.ingress import IngressPlane
+
+    state = ExecutionState()
+    _fold(state, 1, ExecTx(OP_CREATE, b"alice", amount=100))
+    core = _FakeCore(state)
+    plane = IngressPlane(
+        IngressParameters(admission=False, **params)
+    ).attach(core=core)
+    return plane, core, state
+
+
+def test_ingress_sheds_doomed_exec_txs_before_consensus():
+    from mysticeti_tpu.ingress import (
+        SHED_ACCOUNT_EXISTS,
+        SHED_BAD_NONCE,
+        SHED_INSUFFICIENT_BALANCE,
+        SHED_UNKNOWN_ACCOUNT,
+    )
+
+    plane, _, _ = _plane_with_execution()
+    result = plane.submit(
+        "client",
+        [
+            ExecTx(OP_CREATE, b"alice").to_bytes(),
+            ExecTx(OP_MINT, b"alice", nonce=0, amount=1).to_bytes(),
+            ExecTx(OP_MINT, b"ghost", nonce=0).to_bytes(),
+            ExecTx(
+                OP_TRANSFER, b"alice", nonce=1, amount=999, dest=b"b"
+            ).to_bytes(),
+            ExecTx(
+                OP_TRANSFER, b"alice", nonce=1, amount=10, dest=b"b"
+            ).to_bytes(),
+        ],
+    )
+    assert result.accepted == 1 and result.shed == 4
+    assert plane.shed_by_reason == {
+        SHED_ACCOUNT_EXISTS: 1,
+        SHED_BAD_NONCE: 1,
+        SHED_UNKNOWN_ACCOUNT: 1,
+        SHED_INSUFFICIENT_BALANCE: 1,
+    }
+    # Nothing doomed reached the mempool: consensus never pays for it.
+    assert plane.mempool.pending() == 1
+
+
+def test_ingress_identity_lanes_key_on_spending_account():
+    plane, _, _ = _plane_with_execution()
+    valid = ExecTx(
+        OP_TRANSFER, b"alice", nonce=1, amount=10, dest=b"b"
+    ).to_bytes()
+    ordinary = struct.pack("<QQ", 7, 1) + b"\x00" * 16
+    result = plane.submit("conn-1", [valid, ordinary])
+    assert result.accepted == 2
+    stats = plane.mempool.lane_stats()
+    # The execution tx laned by ACCOUNT (sender identity), regardless of
+    # which connection carried it; the ordinary tx kept the caller's lane.
+    account_lane = f"acct:{b'alice'.hex()}"
+    assert stats[account_lane]["pending"] == 1
+    assert stats["conn-1"]["pending"] == 1
+    # A second connection spending the SAME account shares its lane (and
+    # its fairness cap): identity-backed, not connection-backed.
+    valid2 = ExecTx(
+        OP_TRANSFER, b"alice", nonce=2, amount=10, dest=b"c"
+    ).to_bytes()
+    assert plane.submit("conn-2", [valid2]).accepted == 1
+    assert plane.mempool.lane_stats()[account_lane]["pending"] == 2
+
+
+def test_ingress_defers_notification_until_executed():
+    from mysticeti_tpu.ingress import ingress_key
+
+    plane, core, state = _plane_with_execution()
+    seen = []
+    plane.add_commit_sink(lambda h, keys, info: seen.append((h, keys, info)))
+    tx = ExecTx(OP_TRANSFER, b"alice", nonce=1, amount=10, dest=b"b")
+
+    class _Commit:
+        def __init__(self, height, blocks):
+            self.height = height
+            self.blocks = blocks
+
+    block = _block(tx)
+    # The syncer notifies BEFORE the core folds (handle_commit runs ahead
+    # of handle_committed_subdag): the notification must buffer.
+    plane.note_committed([_Commit(2, [block])])
+    assert seen == [] and len(plane._pending_exec) == 1
+    # The fold flushes it — same loop pass — with the root attached.
+    core.fold(2, [block])
+    ((height, keys, info),) = seen
+    assert height == 2
+    assert keys == [ingress_key(tx.to_bytes())]
+    assert info["executed_height"] == 2
+    assert info["executed_root"] == state.root
+    assert plane.executed_height == 2 and plane.executed_root == state.root
+    # /health embeds the executed frontier.
+    health = plane.health_state()
+    assert health["execution"] == {
+        "executed_height": 2,
+        "executed_root": state.root.hex(),
+    }
+
+
+def test_gateway_subscribe_executed_resume_reply_and_stream():
+    """Satellite 1: the resume gap.  A subscriber with ``want_executed``
+    gets an immediate synthetic notification pinning the node's current
+    executed height/root (no keys), then live notifications carrying the
+    EXECUTED suffix — so a resuming client knows exactly where execution
+    stands without racing the stream."""
+    from mysticeti_tpu.ingress import IngressGateway, ingress_key
+    from mysticeti_tpu.network import (
+        GatewayCommitNotification,
+        GatewaySubscribeCommits,
+        _read_frame,
+        _write_frame,
+        decode_message,
+        encode_message,
+    )
+
+    async def main():
+        plane, core, state = _plane_with_execution()
+        resume_root = state.root
+        gateway = await IngressGateway(plane, "127.0.0.1", 0).start()
+        port = gateway._server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            _write_frame(
+                writer,
+                encode_message(GatewaySubscribeCommits(0, want_executed=1)),
+            )
+            await writer.drain()
+            note = decode_message(await _read_frame(reader))
+            assert isinstance(note, GatewayCommitNotification)
+            assert note.height == 1 and note.keys == ()
+            assert note.executed_root == resume_root
+
+            class _Commit:
+                def __init__(self, height, blocks):
+                    self.height = height
+                    self.blocks = blocks
+
+            tx = ExecTx(OP_TRANSFER, b"alice", nonce=1, amount=10, dest=b"b")
+            block = _block(tx)
+            plane.note_committed([_Commit(2, [block])])
+            core.fold(2, [block])
+            note = decode_message(await _read_frame(reader))
+            assert note.height == 2
+            assert note.keys == (ingress_key(tx.to_bytes()),)
+            assert note.executed_root == state.root
+        finally:
+            writer.close()
+            await gateway.stop()
+
+    asyncio.run(main())
+
+
+# -- seeded multi-node sims ---------------------------------------------------
+
+
+def _exec_scenario(**overrides):
+    from mysticeti_tpu.scenarios import scenario_by_name
+
+    return dataclasses.replace(
+        scenario_by_name("execution-byzantine-at-f"), **overrides
+    )
+
+
+@pytest.mark.chaos
+def test_execution_under_byzantine_at_f_roots_agree(tmp_path):
+    """The 10-node acceptance sim: equivocate + withhold + invalid_sig at
+    f=3 with the execution workload live.  Every honest node folds real
+    state and the SafetyChecker's per-height state-root audit holds — a
+    fork would have raised, failing the verdict's safety gate."""
+    from mysticeti_tpu.scenarios import run_scenario
+
+    verdict = run_scenario(_exec_scenario(duration_s=5.0), str(tmp_path))
+    assert verdict["safety_ok"], verdict.get("safety_error")
+    assert verdict["passed"], verdict
+    execution = verdict["execution"]
+    assert execution["execution_ok"]
+    assert execution["chain_length"] > 0
+    heights = execution["executed_heights"]
+    assert len(heights) == 7  # the honest, non-crashed cohort
+    assert all(h > 0 for h in heights.values())
+    # Every honest node executed up to (near) the shared frontier.
+    assert max(heights.values()) - min(heights.values()) <= 2
+
+
+@pytest.mark.chaos
+def test_execution_sim_byte_identical_across_same_seed_runs(tmp_path):
+    """Same seed, same scenario: the agreed state-root CHAIN (height ->
+    root, every honest node) reproduces byte-for-byte, pinned by the
+    verdict's chain digest — execution is a pure function of the seed."""
+    from mysticeti_tpu.chaos import run_chaos_sim
+    from mysticeti_tpu.committee import Committee
+    from mysticeti_tpu.scenarios import (
+        _exec_driver,
+        oracle_verifier_factory,
+    )
+
+    scenario = _exec_scenario(
+        nodes=5, duration_s=3.5, adversaries=(), min_ratio=0.0
+    )
+
+    def once(tag):
+        # Honest plan: the oracle verifier needs per-index keys, which the
+        # adversary path would have defaulted for us (run_chaos_sim).
+        report, harness = run_chaos_sim(
+            scenario.plan(),
+            scenario.nodes,
+            scenario.duration_s,
+            str(tmp_path / tag),
+            parameters=scenario.base_parameters(),
+            latency_ranges=scenario.latency_ranges(),
+            committee=Committee.new_for_benchmarks(scenario.nodes),
+            with_metrics=True,
+            verifier_factory=oracle_verifier_factory(scenario.nodes),
+            extra_fault=_exec_driver(scenario),
+        )
+        return report, harness
+
+    first, harness_a = once("a")
+    second, harness_b = once("b")
+    assert first.state_root_chain  # real state was folded
+    assert first.state_root_chain == second.state_root_chain
+    assert first.executed == second.executed
+    assert first.sequences == second.sequences
+    # The harness-level account tables agree node-for-node too.
+    for a in range(scenario.nodes):
+        exec_a = harness_a.nodes[a].core.execution
+        exec_b = harness_b.nodes[a].core.execution
+        assert exec_a.to_bytes() == exec_b.to_bytes()
+
+
+@pytest.mark.chaos
+def test_execution_crash_restart_rederives_identical_roots(tmp_path):
+    """A node crashing mid-chain recovers its execution state from the
+    checkpoint + WAL tail and re-derives the SAME roots: the checker's
+    self-conflict arm (note_state_root on rebuild) would raise if the
+    recovered chain disagreed with what the node reported pre-crash."""
+    from mysticeti_tpu.chaos import CrashFault, FaultPlan, run_chaos_sim
+    from mysticeti_tpu.scenarios import Scenario, _exec_driver
+
+    scenario = Scenario(
+        name="exec-crash",
+        description="crash-restart execution recovery",
+        nodes=4,
+        duration_s=14.0,
+        seed=23,
+        execution=True,
+    )
+    plan = FaultPlan(
+        seed=23, crashes=[CrashFault(node=1, at_s=5.0, downtime_s=2.0)]
+    )
+    params = Parameters(
+        leader_timeout_s=1.0,
+        execution=True,
+        storage=StorageParameters(
+            segment_bytes=16 * 1024, checkpoint_interval=5, gc_depth=20
+        ),
+    )
+    report, harness = run_chaos_sim(
+        plan,
+        4,
+        scenario.duration_s,
+        str(tmp_path),
+        parameters=params,
+        with_metrics=True,
+        extra_fault=_exec_driver(scenario),
+    )
+    crashed_at = report.crash_events[0]["committed_height"]
+    assert harness.metrics[1].crash_recovery_total._value.get() == 1.0
+    # The restarted node kept executing past its crash point...
+    restarted = harness.nodes[1].core.execution
+    assert restarted.last_height > crashed_at
+    # ...and at every height the checker saw from BOTH node 1 and node 0,
+    # the roots agree (the per-height audit in check() already enforced
+    # this across the whole fleet without raising).
+    shared = 0
+    for height in report.state_root_chain:
+        mine = harness.checker.state_root_at(1, height)
+        theirs = harness.checker.state_root_at(0, height)
+        if mine is not None and theirs is not None:
+            assert mine == theirs
+            shared += 1
+    assert shared > 0
+
+
+@pytest.mark.chaos
+@pytest.mark.storage
+def test_execution_snapshot_rejoiner_lands_on_fleet_root(tmp_path):
+    """A rejoiner whose history was GC'd fleet-wide adopts the execution
+    state off the snapshot manifest and lands on the fleet's exact root —
+    then keeps folding live commits onto the same chain."""
+    from mysticeti_tpu.chaos import CrashFault, FaultPlan, run_chaos_sim
+    from mysticeti_tpu.scenarios import Scenario, _exec_driver
+
+    scenario = Scenario(
+        name="exec-snapshot",
+        description="snapshot rejoiner execution adoption",
+        nodes=4,
+        duration_s=45.0,
+        seed=13,
+        execution=True,
+    )
+    params = Parameters(
+        leader_timeout_s=1.0,
+        execution=True,
+        storage=StorageParameters(
+            segment_bytes=16 * 1024,
+            checkpoint_interval=5,
+            gc_depth=20,
+            snapshot_catchup=True,
+            catchup_threshold_commits=50,
+        ),
+    )
+    plan = FaultPlan(
+        seed=13, crashes=[CrashFault(node=3, at_s=3.0, downtime_s=30.0)]
+    )
+    report, harness = run_chaos_sim(
+        plan,
+        4,
+        scenario.duration_s,
+        str(tmp_path),
+        parameters=params,
+        with_metrics=True,
+        extra_fault=_exec_driver(scenario),
+    )
+    node3 = harness.nodes[3]
+    assert node3.core.storage.snapshots_adopted == 1
+    rejoined = node3.core.execution
+    crashed_at = report.crash_events[0]["committed_height"]
+    # It genuinely skipped history (adopted a baseline past the crash)
+    # and kept executing on the fleet chain.
+    assert rejoined.last_height > crashed_at
+    for height in report.state_root_chain:
+        mine = harness.checker.state_root_at(3, height)
+        theirs = harness.checker.state_root_at(0, height)
+        if mine is not None and theirs is not None:
+            assert mine == theirs
+    # The rejoiner's post-adoption roots entered the agreed chain.
+    assert harness.checker.executed_height(3) > crashed_at
+
+
+# -- planted nondeterminism: the lint and the sanitizer must bite -------------
+
+
+def test_sim_taint_catches_wallclock_flow_into_state_root():
+    """The exact regression the plane must never grow: a wall-clock read
+    folded into the state-root digest.  The sim-taint lint flags the flow
+    statically — before any sim has to diverge."""
+    import textwrap
+
+    from mysticeti_tpu.analysis import analyze_source
+
+    findings = analyze_source(
+        textwrap.dedent(
+            """
+            import hashlib
+            import struct
+            import time
+
+
+            class LeakyExecution:
+                def __init__(self):
+                    self.root = b"\\x00" * 32
+
+                def observe_commit(self, height, deltas):
+                    stamp = time.monotonic()
+                    material = self.root + struct.pack("<d", stamp)
+                    self.root = hashlib.blake2b(
+                        material, digest_size=32
+                    ).digest()
+                    return self.root
+            """
+        ),
+        "mysticeti_tpu/example.py",
+    )
+    assert "sim-taint" in sorted({f.rule for f in findings})
+    messages = " ".join(f.message for f in findings)
+    assert "wall-clock" in messages
+    assert "canonical digest" in messages
+
+
+def test_detsan_run_twice_catches_wallclock_leak_in_exec_fold():
+    """The dynamic twin: an execution fold whose pacing leaks wall clock
+    diverges between same-seed runs and the detsan bisector names the
+    first diverging event; the deterministic twin is event-identical."""
+    from mysticeti_tpu.detsan import run_twice
+
+    def fold_main(leaky):
+        async def main():
+            state = ExecutionState()
+            _fold(state, 1, ExecTx(OP_CREATE, b"a", amount=1000))
+            for height in range(2, 10):
+                if leaky:
+                    jitter = (time.perf_counter_ns() % 997) / 1e5
+                else:
+                    jitter = 0.0
+                await asyncio.sleep(0.01 + jitter)
+                _fold(
+                    state,
+                    height,
+                    ExecTx(
+                        OP_TRANSFER,
+                        b"a",
+                        nonce=height - 1,
+                        amount=1,
+                        dest=b"sink",
+                    ),
+                )
+            return state.root
+
+        return main()
+
+    clean = run_twice(lambda: fold_main(False), seed=7)
+    assert clean.identical, clean.to_dict()
+    leaky = run_twice(lambda: fold_main(True), seed=7)
+    assert not leaky.identical
+    assert leaky.first_divergence is not None

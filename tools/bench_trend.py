@@ -44,7 +44,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 ARTIFACT_GLOBS = (
     "BENCH_*.json", "MAXLOAD_*.json", "TENNODE_*.json", "OVERLOAD_*.json",
     "SCENARIO_*.json", "PERF_ATTR_*.json", "DETSAN_*.json",
-    "FINALITY_*.json", "RECONFIG_*.json",
+    "FINALITY_*.json", "RECONFIG_*.json", "EXEC_*.json",
 )
 
 # >10% below the best prior round fails the gate.
@@ -310,6 +310,38 @@ def normalize(path: str) -> List[dict]:
             return out
         return [_record(round_, source, "unparsed", None, "",
                         note="finality artifact with no scored percentiles")]
+
+    # EXEC: the execution-plane artifact (tools/execution_bench.py).
+    # Executed tx/s scores on the generic higher-is-better gate; every
+    # agreement/determinism verdict scores pass (1.0) / fail (0.0), so the
+    # gate fires exactly when state-root agreement or same-seed
+    # reproducibility FLIPS.
+    if doc.get("metric") == "execution":
+        fleet = doc.get("fleet") or {}
+        sim = doc.get("sim") or {}
+        if fleet.get("executed_tx_s"):
+            out.append(_record(
+                round_, source, f"{family}.executed_tx_s",
+                fleet["executed_tx_s"], "tx/s",
+                nodes=doc.get("nodes"),
+                executed_height_max=fleet.get("executed_height_max"),
+            ))
+        acceptance = doc.get("acceptance") or {}
+        for key in ("fleet_roots_agree", "sim_passed", "sim_execution_ok"):
+            if acceptance.get(key) is not None:
+                out.append(_record(round_, source, f"{family}.{key}",
+                                   1.0 if acceptance[key] else 0.0, "pass"))
+        determinism = doc.get("determinism") or {}
+        if determinism.get("byte_identical") is not None:
+            out.append(_record(
+                round_, source, f"{family}.root_chain_byte_identical",
+                1.0 if determinism["byte_identical"] else 0.0, "pass",
+                digest=determinism.get("root_chain_digest"),
+            ))
+        if out:
+            return out
+        return [_record(round_, source, "unparsed", None, "",
+                        note="execution artifact with no verdicts")]
 
     # PERF_ATTR: the host attribution artifact (tools/perf_attr.py).  One
     # budget row per subsystem, scored as committed leaders per CPU-second
